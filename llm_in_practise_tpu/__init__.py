@@ -1,0 +1,16 @@
+"""llm_in_practise_tpu — a TPU-native LLM framework (JAX/XLA/pjit/Pallas).
+
+Brand-new implementation of the capabilities of the iKubernetes/llm-in-practise
+curriculum (see /root/repo/SURVEY.md): from-scratch GPT / DeepSeek-style model
+training, distributed pre-training (DP / ZeRO-1/2/3 / FSDP equivalents over a
+`jax.sharding.Mesh`), LoRA/QLoRA fine-tuning with Pallas NF4 kernels, GPTQ/AWQ
+post-training quantization, and a KV-cached OpenAI-compatible serving stack.
+
+Design is TPU-first: parallelism is expressed as NamedSharding over mesh axes
+(`data`, `fsdp`, `model`, `expert`, `seq`) with XLA emitting the collectives,
+replacing the reference's NCCL/DDP/DeepSpeed engines.
+"""
+
+__version__ = "0.1.0"
+
+from llm_in_practise_tpu.core.mesh import MeshSpec, build_mesh  # noqa: F401

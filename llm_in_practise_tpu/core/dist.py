@@ -1,0 +1,80 @@
+"""Multi-host runtime init — replaces torchrun/deepspeed/accelerate rendezvous.
+
+The reference uses three launchers with env-var rendezvous
+(``RANK/WORLD_SIZE/LOCAL_RANK/MASTER_ADDR`` — reference
+``ddp_basics/README.md:66-120``, ``DeepSpeed-GPTLike-Multihosts/hostfile``,
+``Fine-Tuning/multi_hosts.ymal``). The TPU-native flow is a single call to
+:func:`initialize`, after which every host sees the same global device list and
+participates in compiled ICI/DCN collectives; there is no per-strategy backend
+choice (NCCL vs Gloo) to make.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize the multi-host JAX runtime (no-op for single process).
+
+    ``coordinator_address`` plays the role of the reference's
+    ``MASTER_ADDR:MASTER_PORT``. On TPU pods all three args are usually
+    auto-detected from the environment and may be omitted.
+    Env fallbacks: ``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``, ``PROCESS_ID``.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        log.info("single-process run; skipping jax.distributed.initialize")
+        _INITIALIZED = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+    log.info(
+        "distributed init: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def shutdown() -> None:
+    """Graceful teardown (parity with destroy_process_group + barrier —
+    reference ``DeepSpeed-GPTLike-ZeRO-1.py:347-363``)."""
+    global _INITIALIZED
+    if _INITIALIZED and jax.process_count() > 1:
+        jax.distributed.shutdown()
+    _INITIALIZED = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_coordinator() -> bool:
+    """True on the process that should do filesystem writes / logging.
+
+    Mirrors the reference's pervasive ``rank == 0`` gating
+    (``ddp_gpt_wikitext2.py:316-331``).
+    """
+    return jax.process_index() == 0

@@ -1,0 +1,102 @@
+"""Mesh & topology: the TPU-native replacement for process groups.
+
+The reference wires distributed training through
+``dist.init_process_group("nccl"|"gloo")`` plus per-strategy wrapper engines
+(DDP / FSDP / DeepSpeed — see reference
+``LLM_Distributed_Trainning/PyTorch/ddp_basics/ddp_gpt_wikitext2.py:170-186``).
+Here a single ``jax.sharding.Mesh`` with named axes subsumes all of those:
+
+- ``data``   — batch sharding (DDP parity; gradient all-reduce compiled by XLA)
+- ``fsdp``   — parameter/optimizer/grad sharding (ZeRO-3 / FSDP parity)
+- ``model``  — tensor parallelism (attention heads / FFN hidden)
+- ``expert`` — MoE expert parallelism
+- ``seq``    — sequence/context parallelism (ring attention)
+
+Strategies in :mod:`llm_in_practise_tpu.parallel.strategy` pick axis sizes and
+parameter partition rules; XLA inserts the ICI/DCN collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis names, in mesh order.
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "model"
+AXIS_EXPERT = "expert"
+AXIS_SEQ = "seq"
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_EXPERT, AXIS_SEQ)
+
+# Batch dims are sharded over both data-like axes so DP and FSDP compose.
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. ``-1`` on at most one axis means "all remaining".
+
+    Mirrors the knob surface of the reference launchers (``--nproc_per_node``,
+    DeepSpeed ``hostfile`` slots) as a declarative topology instead of env vars.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    expert: int = 1
+    seq: int = 1
+
+    def sizes(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.model, self.expert, self.seq)
+
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        sizes = list(self.sizes())
+        wildcards = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {self}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wildcards:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {fixed} devices but {n_devices} are available"
+            )
+        return tuple(sizes)
+
+
+def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    """Build a 5-axis device mesh covering all available devices."""
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = spec.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a per-step batch: leading dim split over data×fsdp."""
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_batch_size(mesh: Mesh, global_batch_size: int) -> int:
+    n = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+    if global_batch_size % n != 0:
+        raise ValueError(f"global batch {global_batch_size} not divisible by {n}")
+    return global_batch_size // n

@@ -1,0 +1,43 @@
+"""Mixed-precision policy — the TPU analog of AMP autocast + GradScaler.
+
+The reference uses ``torch.cuda.amp.autocast`` + ``GradScaler`` (reference
+``temp/ddp_gpt_bpe_tokenizer_02.py:385-418``) and bf16 flags in DeepSpeed/HF
+configs. On TPU the idiom is simpler: keep parameters in fp32 (or bf16),
+compute in bf16 on the MXU, and skip loss scaling entirely — bf16 has fp32's
+exponent range, so there is nothing to scale. ``Policy`` carries the dtypes;
+models cast at boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_compute(self, x):
+        return jnp.asarray(x, self.compute_dtype)
+
+    def cast_output(self, x):
+        return jnp.asarray(x, self.output_dtype)
+
+
+DEFAULT = Policy()
+FULL_F32 = Policy(compute_dtype=jnp.float32)
+PURE_BF16 = Policy(param_dtype=jnp.bfloat16)
+
+
+def policy_from_name(name: str) -> Policy:
+    return {
+        "default": DEFAULT,
+        "bf16": DEFAULT,
+        "f32": FULL_F32,
+        "float32": FULL_F32,
+        "pure_bf16": PURE_BF16,
+    }[name]

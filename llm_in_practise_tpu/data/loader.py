@@ -1,0 +1,70 @@
+"""Minimal array batching — DataLoader + DistributedSampler, the TPU way.
+
+The reference pairs ``DataLoader`` with ``DistributedSampler(num_replicas,
+rank)`` and calls ``sampler.set_epoch(epoch)`` so every rank sees a disjoint,
+reshuffled shard (``ddp_basics/ddp_gpt_wikitext2.py:242-247,292-294``). In the
+JAX SPMD model each *process* feeds its slice of a globally-sharded batch; on
+a single process the iterator yields full global batches which the train step
+shards via NamedSharding. ``epoch`` seeds the shuffle — the ``set_epoch``
+analog — so multi-process runs stay in lockstep without communication.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterator
+
+import numpy as np
+
+
+def batch_iterator(
+    arrays: tuple[np.ndarray, ...],
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_last: bool = True,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Iterator[tuple[np.ndarray, ...]]:
+    """Yield per-process batch tuples from aligned arrays.
+
+    With ``process_count > 1`` each process gets a disjoint interleaved shard
+    of every batch (DistributedSampler parity); the per-process batch is
+    ``batch_size // process_count``.
+    """
+    n = len(arrays[0])
+    if batch_size % process_count != 0:
+        raise ValueError(f"batch {batch_size} not divisible by {process_count} processes")
+    order = np.arange(n)
+    if shuffle:
+        rng = np.random.default_rng(seed + epoch)  # set_epoch parity
+        rng.shuffle(order)
+    if process_count > 1:
+        # a partial final batch would give processes different shapes and
+        # desynchronize SPMD collectives — always drop it multi-process
+        drop_last = True
+    n_batches = n // batch_size if drop_last else -(-n // batch_size)
+    for b in range(n_batches):
+        idx = order[b * batch_size : (b + 1) * batch_size]
+        idx = idx[process_index::process_count]
+        yield tuple(a[idx] for a in arrays)
+
+
+def num_batches(n_examples: int, batch_size: int, drop_last: bool = True) -> int:
+    return n_examples // batch_size if drop_last else -(-n_examples // batch_size)
+
+
+def train_val_split(
+    arrays: tuple[np.ndarray, ...], val_fraction: float = 0.1, seed: int = 42
+) -> tuple[tuple[np.ndarray, ...], tuple[np.ndarray, ...]]:
+    """Seeded random split (parity with ``random_split`` + manual-seeded
+    generator — reference ``temp/ddp_gpt_bpe_tokenizer_02.py:262-300``)."""
+    n = len(arrays[0])
+    order = np.random.default_rng(seed).permutation(n)
+    n_val = int(n * val_fraction)
+    val_idx, train_idx = order[:n_val], order[n_val:]
+    return (
+        tuple(a[train_idx] for a in arrays),
+        tuple(a[val_idx] for a in arrays),
+    )

@@ -1,0 +1,43 @@
+"""Token sampling: greedy / temperature / top-k / top-p.
+
+Parity with the reference's decode styles: greedy argmax
+(``llm-demo/minigpt/generate.py:14-28``), temperature + multinomial
+(``minigpt2/test_model.py:35-57``), top-k/top-p HF ``generate`` kwargs
+(``Scripts/inference/04-*.py``). All jittable (static shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_token(
+    rng: jax.Array,
+    logits: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    greedy: bool = False,
+) -> jax.Array:
+    """Sample next token ids from (..., vocab) logits."""
+    if greedy or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep top-1)
+        cutoff_mask = cum - probs > top_p
+        cutoff_logit = jnp.min(
+            jnp.where(cutoff_mask, jnp.inf, sorted_logits), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff_logit, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1)

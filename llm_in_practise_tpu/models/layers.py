@@ -1,0 +1,183 @@
+"""Shared transformer building blocks (flax.linen).
+
+One block implementation serves the whole from-scratch model family of the
+reference curriculum — MiniGPT (post-LN encoder blocks, reference
+``llm-demo/minigpt2/model.py:40-74``), GPTLike (pre-LN decoder,
+``GPTLike_wikitext2_learned_pe.py:118-160``) — via the ``norm_first`` switch.
+Attention funnels through :func:`llm_in_practise_tpu.ops.attention.dot_product_attention`
+so the Pallas flash kernel is picked up everywhere on TPU.
+
+KV caches are explicit pytrees (dict with ``k``, ``v``, ``index``) threaded
+through ``__call__`` — no mutable module state, so the decode step jits
+cleanly and shards like any other value.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.ops import rope as rope_ops
+from llm_in_practise_tpu.ops.attention import dot_product_attention
+
+Cache = dict[str, Any]
+
+dense_init = nn.initializers.normal(stddev=0.02)
+
+
+def _activation(name: str):
+    return {"gelu": nn.gelu, "relu": nn.relu, "silu": nn.silu}[name]
+
+
+def init_cache(
+    batch: int, max_len: int, n_kv_head: int, head_dim: int, n_layer: int,
+    dtype=jnp.bfloat16,
+) -> list[Cache]:
+    """Pre-allocated static-shape KV cache, one entry per layer."""
+    return [
+        {
+            "k": jnp.zeros((batch, max_len, n_kv_head, head_dim), dtype),
+            "v": jnp.zeros((batch, max_len, n_kv_head, head_dim), dtype),
+            "index": jnp.zeros((), jnp.int32),
+        }
+        for _ in range(n_layer)
+    ]
+
+
+class CausalSelfAttention(nn.Module):
+    """Multi-head causal self-attention with optional RoPE and KV cache."""
+
+    embed_dim: int
+    n_head: int
+    dropout: float = 0.0
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    max_seq_len: int = 2048
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        deterministic: bool = True,
+        cache: Cache | None = None,
+        positions: jax.Array | None = None,
+    ) -> tuple[jax.Array, Cache | None]:
+        b, l, _ = x.shape
+        head_dim = self.embed_dim // self.n_head
+        qkv_dense = lambda name: nn.Dense(
+            self.embed_dim, kernel_init=dense_init, name=name
+        )
+        q = qkv_dense("q_proj")(x).reshape(b, l, self.n_head, head_dim)
+        k = qkv_dense("k_proj")(x).reshape(b, l, self.n_head, head_dim)
+        v = qkv_dense("v_proj")(x).reshape(b, l, self.n_head, head_dim)
+
+        if self.use_rope:
+            cos, sin = rope_ops.precompute_cos_sin(
+                head_dim, self.max_seq_len, self.rope_theta
+            )
+            if positions is None and cache is not None:
+                positions = cache["index"] + jnp.arange(l)[None, :]
+                positions = jnp.broadcast_to(positions, (b, l))
+            q = rope_ops.apply_rotary_emb(q, cos, sin, positions=positions)
+            k = rope_ops.apply_rotary_emb(k, cos, sin, positions=positions)
+
+        q_offset = None
+        if cache is not None:
+            q_offset = cache["index"]  # absolute position of first query
+            k_cache = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, cache["index"], 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, cache["index"], 0, 0)
+            )
+            cache = {"k": k_cache, "v": v_cache, "index": cache["index"] + l}
+            k, v = k_cache.astype(q.dtype), v_cache.astype(q.dtype)
+
+        dropout_rng = None
+        if not deterministic and self.dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        # With a cache, q_offset-based causal masking handles both future
+        # prompt positions (multi-token prefill) and unwritten cache slots.
+        out = dot_product_attention(
+            q, k, v,
+            causal=True,
+            q_offset=q_offset,
+            dropout_rate=0.0 if deterministic else self.dropout,
+            dropout_rng=dropout_rng,
+            impl=self.attn_impl,
+        )
+        out = out.reshape(b, l, self.embed_dim)
+        out = nn.Dense(self.embed_dim, kernel_init=dense_init, name="out_proj")(out)
+        out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        return out, cache
+
+
+class MLP(nn.Module):
+    """Position-wise FFN: Dense → activation → Dense → dropout."""
+
+    embed_dim: int
+    hidden_dim: int
+    dropout: float = 0.0
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        h = nn.Dense(self.hidden_dim, kernel_init=dense_init, name="fc_in")(x)
+        h = _activation(self.activation)(h)
+        h = nn.Dense(self.embed_dim, kernel_init=dense_init, name="fc_out")(h)
+        return nn.Dropout(self.dropout)(h, deterministic=deterministic)
+
+
+class TransformerBlock(nn.Module):
+    """Attention + FFN with residuals; pre-LN or post-LN."""
+
+    embed_dim: int
+    n_head: int
+    mlp_ratio: float = 4.0
+    dropout: float = 0.0
+    norm_first: bool = True
+    activation: str = "gelu"
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    max_seq_len: int = 2048
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        deterministic: bool = True,
+        cache: Cache | None = None,
+        positions: jax.Array | None = None,
+    ) -> tuple[jax.Array, Cache | None]:
+        attn = CausalSelfAttention(
+            self.embed_dim, self.n_head, self.dropout,
+            use_rope=self.use_rope, rope_theta=self.rope_theta,
+            max_seq_len=self.max_seq_len, attn_impl=self.attn_impl,
+            name="attn",
+        )
+        mlp = MLP(
+            self.embed_dim, int(self.embed_dim * self.mlp_ratio),
+            self.dropout, self.activation, name="mlp",
+        )
+        ln1 = nn.LayerNorm(name="ln1")
+        ln2 = nn.LayerNorm(name="ln2")
+        if self.norm_first:
+            a, cache = attn(
+                ln1(x), deterministic=deterministic, cache=cache, positions=positions
+            )
+            x = x + a
+            x = x + mlp(ln2(x), deterministic=deterministic)
+        else:  # post-LN (torch TransformerEncoderLayer default)
+            a, cache = attn(
+                x, deterministic=deterministic, cache=cache, positions=positions
+            )
+            x = ln1(x + a)
+            x = ln2(x + mlp(x, deterministic=deterministic))
+        return x, cache

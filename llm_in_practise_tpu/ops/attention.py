@@ -1,0 +1,145 @@
+"""Scaled dot-product causal attention with a pluggable implementation.
+
+This is the single dispatch point for attention in the framework. The
+reference computes attention three ways (``nn.MultiheadAttention`` + triu mask
+— ``GPTLike_wikitext2_learned_pe.py:118-130``; explicit matmul+mask in MLA —
+``DeepSeekLike_spare_MoE_wikitext2.py:212-226``; torch SDPA inside
+``nn.TransformerEncoder``). Here everything funnels through
+:func:`dot_product_attention`, which picks:
+
+- ``dense`` — pure-XLA einsum attention (works everywhere, incl. CPU tests)
+- ``flash`` — Pallas TPU flash-attention kernel (O(L) memory, MXU-tiled)
+- ``auto``  — flash on TPU when shapes allow, dense otherwise
+
+Convention: q/k/v are ``(batch, length, heads, head_dim)`` (flax layout).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def causal_mask(
+    q_len: int, kv_len: int, dtype=jnp.float32, q_offset: jax.Array | int | None = None
+) -> jax.Array:
+    """Additive causal mask of shape (1, 1, q_len, kv_len).
+
+    ``q_offset`` is the absolute position of the first query. Default places
+    the query block at the end of the kv sequence (plain decode); a KV-cached
+    prefill passes the cache write index so queries mid-buffer mask both
+    future prompt positions and unwritten cache slots.
+    """
+    if q_offset is None:
+        q_offset = kv_len - q_len
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    allowed = kv_pos <= q_pos
+    return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[None, None]
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: jax.Array | None = None,
+    kv_length: jax.Array | None = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    scale: float | None = None,
+    q_offset: jax.Array | int | None = None,
+) -> jax.Array:
+    """Reference XLA attention. q: (B, Lq, H, D), k/v: (B, Lk, H, D).
+
+    ``kv_length``: optional (B,) valid kv lengths (for padded KV caches).
+    ``q_offset``: absolute position of the first query (KV-cached prefill).
+    """
+    _, q_len, _, head_dim = q.shape
+    kv_len = k.shape[1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    # (B, H, Lq, Lk) logits in f32 for numerical stability.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        logits = logits + causal_mask(q_len, kv_len, q_offset=q_offset)
+    if kv_length is not None:
+        kv_pos = jnp.arange(kv_len)[None, None, None, :]
+        valid = kv_pos < kv_length[:, None, None, None]
+        logits = jnp.where(valid, logits, NEG_INF)
+    if bias is not None:
+        logits = logits + bias
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: jax.Array | None = None,
+    kv_length: jax.Array | None = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    scale: float | None = None,
+    q_offset: jax.Array | int | None = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Attention entry point used by every model in the framework."""
+    if impl == "auto":
+        impl = _pick_impl(q, bias, kv_length, dropout_rate)
+    if impl == "flash":
+        from llm_in_practise_tpu.ops import flash_attention as fa
+
+        if bias is None and kv_length is None and dropout_rate == 0.0 and q_offset is None:
+            return fa.flash_attention(q, k, v, causal=causal, scale=scale)
+        impl = "dense"  # flash kernel doesn't cover these yet
+    return dense_attention(
+        q, k, v,
+        causal=causal, bias=bias, kv_length=kv_length,
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng, scale=scale,
+        q_offset=q_offset,
+    )
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.cache
+def _flash_available() -> bool:
+    try:
+        from llm_in_practise_tpu.ops import flash_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _pick_impl(q, bias, kv_length, dropout_rate) -> str:
+    if (
+        not _on_tpu()
+        or not _flash_available()
+        or bias is not None
+        or kv_length is not None
+        or dropout_rate
+    ):
+        return "dense"
+    _, q_len, _, head_dim = q.shape
+    if q_len % 128 == 0 and head_dim in (64, 128, 256):
+        return "flash"
+    return "dense"

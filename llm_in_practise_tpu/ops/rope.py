@@ -1,0 +1,68 @@
+"""Rotary position embeddings (RoPE), both reference formulations.
+
+The reference implements RoPE twice: via complex ``freqs_cis``
+(``DeepSeekLike_wikitext2.py:122-160``) and via interleaved cos/sin
+(``DeepSeekLike_spare_MoE_wikitext2.py:131-174``). Both are the same rotation;
+we implement the interleaved-pair form (even/odd lanes rotated together) as
+the canonical one, precomputing cos/sin tables once per model.
+
+Layout: q/k are ``(batch, length, heads, head_dim)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def precompute_cos_sin(
+    head_dim: int, max_seq_len: int, theta: float = 10000.0
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables of shape (max_seq_len, head_dim // 2), fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    positions = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(positions, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary_emb(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    *,
+    positions: jax.Array | None = None,
+) -> jax.Array:
+    """Rotate interleaved even/odd feature pairs of x: (B, L, H, D).
+
+    ``positions``: optional (B, L) absolute positions (for KV-cached decode);
+    defaults to ``arange(L)``.
+    """
+    b, l, _, d = x.shape
+    if positions is None:
+        cos_l = cos[:l][None, :, None, :]  # (1, L, 1, D/2)
+        sin_l = sin[:l][None, :, None, :]
+    else:
+        cos_l = cos[positions][:, :, None, :]  # (B, L, 1, D/2)
+        sin_l = sin[positions][:, :, None, :]
+    x_pairs = x.astype(jnp.float32).reshape(b, l, x.shape[2], d // 2, 2)
+    x_even, x_odd = x_pairs[..., 0], x_pairs[..., 1]
+    rot_even = x_even * cos_l - x_odd * sin_l
+    rot_odd = x_even * sin_l + x_odd * cos_l
+    out = jnp.stack([rot_even, rot_odd], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def sinusoidal_embeddings(max_len: int, dim: int) -> jax.Array:
+    """Classic fixed sinusoidal position table (max_len, dim).
+
+    Parity with ``get_sinusoidal_embeddings`` —
+    reference ``GPTLike_wikitext2_fixed_pe.py:178-190``.
+    """
+    position = jnp.arange(max_len, dtype=jnp.float32)[:, None]
+    div_term = jnp.exp(
+        jnp.arange(0, dim, 2, dtype=jnp.float32) * (-jnp.log(10000.0) / dim)
+    )
+    pe = jnp.zeros((max_len, dim), dtype=jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(position * div_term))
+    pe = pe.at[:, 1::2].set(jnp.cos(position * div_term))
+    return pe

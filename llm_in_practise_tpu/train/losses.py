@@ -1,0 +1,36 @@
+"""Loss functions for LM training and SFT.
+
+Next-token cross-entropy with optional label masking: the reference uses
+``nn.CrossEntropyLoss`` over flattened logits for pretraining
+(``minigpt2/model.py:104``) and ``ignore_index=-100`` label masking for SFT
+(``Fine-Tuning/qwen3-8b-lora.py:66-103``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy(
+    logits: jax.Array, labels: jax.Array, *, ignore_index: int = IGNORE_INDEX
+) -> tuple[jax.Array, jax.Array]:
+    """Mean token cross-entropy. Returns (loss, n_valid_tokens).
+
+    logits: (..., vocab) float; labels: (...) int, ``ignore_index`` masked out.
+    Computed in fp32 regardless of logits dtype.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    n_valid = jnp.maximum(valid.sum(), 1)
+    loss = -(token_ll * valid).sum() / n_valid
+    return loss, n_valid
+
+
+def perplexity(mean_nll: jax.Array) -> jax.Array:
+    return jnp.exp(mean_nll)

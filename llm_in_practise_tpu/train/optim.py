@@ -1,0 +1,44 @@
+"""Optimizers: AdamW + grad clipping + accumulation, as one optax chain.
+
+Covers the reference's optimizer surface: AdamW with weight decay
+(``minigpt2/model.py:89-94``), ``clip_grad_norm_(1.0)`` (``:108``), gradient
+accumulation (``temp/ddp_gpt_bpe_tokenizer_02.py:402-418``), and the
+DeepSpeed/HF fused-Adam settings expressed as plain optax. Quantized (8-bit)
+optimizer state — the ``paged_adamw_8bit`` analog — lives in
+:mod:`llm_in_practise_tpu.train.quant_opt`.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def adamw(
+    learning_rate,
+    *,
+    weight_decay: float = 0.01,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    clip_norm: float | None = 1.0,
+    grad_accum_steps: int = 1,
+) -> optax.GradientTransformation:
+    """AdamW chain: [clip] -> adamw [-> accumulate]."""
+    parts = []
+    if clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(clip_norm))
+    parts.append(
+        optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    )
+    tx = optax.chain(*parts)
+    if grad_accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=grad_accum_steps)
+    return tx
+
+
+def sgd(learning_rate, *, momentum: float = 0.0, clip_norm: float | None = None):
+    parts = []
+    if clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(clip_norm))
+    parts.append(optax.sgd(learning_rate, momentum=momentum))
+    return optax.chain(*parts)

@@ -1,0 +1,47 @@
+"""LR schedules mirroring the reference's set.
+
+- constant (MiniGPT — ``minigpt2/model.py:89-94``)
+- cosine with warmup (``temp/ddp_gpt_bpe_tokenizer_02.py`` cosine; HF Trainer
+  ``lr_scheduler_type="cosine"`` in every Fine-Tuning script)
+- StepLR-style step decay (``DeepSeekLike_spare_MoE_wikitext2.py`` StepLR)
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def constant(lr: float) -> optax.Schedule:
+    return optax.constant_schedule(lr)
+
+
+def cosine_with_warmup(
+    lr: float, total_steps: int, warmup_steps: int = 0, final_scale: float = 0.0
+) -> optax.Schedule:
+    if total_steps <= 0:
+        raise ValueError("cosine schedule requires total_steps > 0")
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0 if warmup_steps else lr,
+        peak_value=lr,
+        warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=lr * final_scale,
+    )
+
+
+def step_decay(lr: float, step_size: int, gamma: float = 0.5) -> optax.Schedule:
+    def schedule(count):
+        return lr * gamma ** (count // step_size)
+
+    return schedule
+
+
+def by_name(name: str, lr: float, *, total_steps: int = 0, warmup_steps: int = 0,
+            step_size: int = 1000, gamma: float = 0.5) -> optax.Schedule:
+    if name == "constant":
+        return constant(lr)
+    if name == "cosine":
+        return cosine_with_warmup(lr, total_steps, warmup_steps)
+    if name == "step":
+        return step_decay(lr, step_size, gamma)
+    raise ValueError(f"unknown schedule {name!r}")

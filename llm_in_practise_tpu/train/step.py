@@ -1,0 +1,75 @@
+"""Jitted train/eval steps — the hot loop, compiled once.
+
+The reference's hot loop (forward → CE loss → backward → clip → step —
+``minigpt2/model.py:99-112``, ``ddp_gpt_wikitext2.py:289-310``) becomes a
+single jitted function over a TrainState; under a sharded mesh XLA compiles
+the gradient all-reduce / reduce-scatter into the same program (no DDP hooks,
+no engine.backward).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax.training import train_state
+
+from llm_in_practise_tpu.train.losses import cross_entropy
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState + dropout rng seed folded per step."""
+
+    rng: jax.Array = None
+
+
+def create_train_state(model, params, tx, rng) -> TrainState:
+    return TrainState.create(apply_fn=model.apply, params=params, tx=tx, rng=rng)
+
+
+def make_train_step(
+    *,
+    loss_fn: Callable | None = None,
+    donate: bool = True,
+) -> Callable[[TrainState, tuple[jax.Array, jax.Array]], tuple[TrainState, dict]]:
+    """Build the jitted step. ``loss_fn(params, apply_fn, batch, rng)`` may be
+    overridden (e.g. MoE aux losses); default is next-token cross-entropy.
+    """
+
+    def default_loss(params, apply_fn, batch, rng):
+        x, y = batch
+        logits = apply_fn(
+            {"params": params}, x, deterministic=False, rngs={"dropout": rng}
+        )
+        loss, n_valid = cross_entropy(logits, y)
+        return loss, {"n_valid": n_valid}
+
+    loss_fn = loss_fn or default_loss
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict[str, Any]]:
+        rng = jax.random.fold_in(state.rng, state.step)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.apply_fn, batch, rng
+        )
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), **aux}
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(*, loss_fn: Callable | None = None):
+    def default_loss(params, apply_fn, batch):
+        x, y = batch
+        logits = apply_fn({"params": params}, x, deterministic=True)
+        loss, n_valid = cross_entropy(logits, y)
+        return loss, n_valid
+
+    loss_fn = loss_fn or default_loss
+
+    def step(state: TrainState, batch):
+        loss, n_valid = loss_fn(state.params, state.apply_fn, batch)
+        return {"loss": loss, "n_valid": n_valid}
+
+    return jax.jit(step)

@@ -1,0 +1,129 @@
+"""End-to-end MiniGPT slice: shape test, training convergence, checkpoint
+round-trip, KV-cached generation.
+
+Mirrors the reference's verification style: output-shape assertion
+(``minigpt2/test_model.py:59-66``), train-and-watch-loss
+(``minigpt2/model.py:99-112``), checkpoint dict with vocab + config
+(``:114-119``), sliding-window generation (``minigpt/generate.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_in_practise_tpu.data.chardata import CharTokenizer, char_lm_examples
+from llm_in_practise_tpu.data.loader import batch_iterator
+from llm_in_practise_tpu.infer.generate import generate
+from llm_in_practise_tpu.models.gpt import GPT, GPTConfig, minigpt_config
+from llm_in_practise_tpu.train import optim, step as step_lib
+from llm_in_practise_tpu.ckpt import checkpoint as ckpt
+
+TEXT = "hello tpu world! " * 8
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    x, y, tok = char_lm_examples(TEXT, seq_len=16)
+    cfg = minigpt_config(tok.vocab_size, seq_len=16, n_layer=2, n_head=2,
+                         embed_dim=32, dropout=0.0)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+    return model, cfg, params, x, y, tok
+
+
+def test_output_shape(tiny_setup):
+    model, cfg, params, x, *_ = tiny_setup
+    logits = model.apply({"params": params}, x[:1])
+    assert logits.shape == (1, 16, cfg.vocab_size)
+
+
+def test_training_reduces_loss(tiny_setup):
+    model, cfg, params, x, y, tok = tiny_setup
+    tx = optim.adamw(3e-3, weight_decay=0.1, clip_norm=1.0)
+    # copy: the jitted step donates its input state, and the fixture's params
+    # are shared across tests in this module
+    params = jax.tree_util.tree_map(jnp.copy, params)
+    state = step_lib.create_train_state(model, params, tx, jax.random.PRNGKey(1))
+    train_step = step_lib.make_train_step()
+    first = last = None
+    for epoch in range(30):
+        for batch in batch_iterator((x, y), 8, seed=0, epoch=epoch):
+            state, metrics = train_step(state, batch)
+            if first is None:
+                first = float(metrics["loss"])
+            last = float(metrics["loss"])
+    assert last < first * 0.5, (first, last)
+    # save for generation test via module attr
+    test_training_reduces_loss.state = state
+
+
+def test_checkpoint_roundtrip(tmp_path, tiny_setup):
+    model, cfg, params, x, y, tok = tiny_setup
+    meta = {"config": cfg.to_dict(), "vocab": tok.to_dict()}
+    path = ckpt.save_checkpoint(str(tmp_path), {"params": params}, 7, metadata=meta)
+    assert path is not None and path.endswith("00000007.msgpack")
+    assert ckpt.latest_checkpoint(str(tmp_path)) == path
+    restored, meta2 = ckpt.restore_checkpoint(path, {"params": params})
+    assert meta2["step"] == 7
+    cfg2 = GPTConfig.from_dict(meta2["config"])
+    assert cfg2 == cfg
+    tok2 = CharTokenizer.from_dict(meta2["vocab"])
+    assert tok2.stoi == tok.stoi
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored["params"], params,
+    )
+
+
+def test_checkpoint_rotation(tmp_path, tiny_setup):
+    model, cfg, params, *_ = tiny_setup
+    for s in range(8):
+        ckpt.save_checkpoint(str(tmp_path), {"p": jnp.zeros(1)}, s, keep=3)
+    latest = ckpt.latest_checkpoint(str(tmp_path))
+    assert latest.endswith("00000007.msgpack")
+    import os
+    n = len([f for f in os.listdir(tmp_path) if f.endswith(".msgpack")])
+    assert n == 3
+
+
+def test_generation_shapes_and_cache_consistency(tiny_setup):
+    model, cfg, params, x, y, tok = tiny_setup
+    prompt = jnp.asarray(tok.encode("hello")[None, :])
+    out = generate(model, params, prompt, max_new_tokens=8, greedy=True,
+                   cache_dtype=jnp.float32)
+    assert out.shape[0] == 1 and out.shape[1] == prompt.shape[1] + 8
+    text = tok.decode(np.asarray(out[0]))
+    assert text.startswith("hello")
+    # cached decode must equal full re-forward decode (greedy)
+    full = prompt
+    for _ in range(8):
+        logits = model.apply({"params": params}, full[:, -cfg.seq_len:])
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
+        full = jnp.concatenate([full, nxt], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+
+
+def test_cached_prefill_matches_uncached_forward(tiny_setup):
+    """Multi-token prefill through the KV cache must be causal: every
+    position's logits must match the plain (uncached) forward pass."""
+    model, cfg, params, x, y, tok = tiny_setup
+    prompt = jnp.asarray(x[:2, :9])
+    plain = model.apply({"params": params}, prompt)
+    cache = model.init_cache(2, cfg.seq_len, dtype=jnp.float32)
+    cached, _ = model.apply({"params": params}, prompt, cache=cache)
+    np.testing.assert_allclose(
+        np.asarray(plain), np.asarray(cached), atol=1e-5
+    )
+
+
+def test_trained_model_memorizes(tiny_setup):
+    state = getattr(test_training_reduces_loss, "state", None)
+    if state is None:
+        pytest.skip("training test did not run first")
+    model, cfg, params, x, y, tok = tiny_setup
+    prompt = jnp.asarray(tok.encode("hello tpu")[None, :])
+    out = generate(model, state.params, prompt, max_new_tokens=6, greedy=True,
+                   cache_dtype=jnp.float32)
+    text = tok.decode(np.asarray(out[0]))
+    assert text.startswith("hello tpu wor"), text
