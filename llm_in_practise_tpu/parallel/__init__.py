@@ -1,0 +1,31 @@
+from llm_in_practise_tpu.parallel import strategy
+from llm_in_practise_tpu.parallel.strategy import (
+    DEFAULT_RULES,
+    Strategy,
+    by_name,
+    ddp,
+    expert_parallel,
+    fsdp,
+    fsdp_tp,
+    param_shardings,
+    shard_init,
+    tensor_parallel,
+    zero1,
+    zero2,
+)
+
+__all__ = [
+    "DEFAULT_RULES",
+    "Strategy",
+    "by_name",
+    "ddp",
+    "expert_parallel",
+    "fsdp",
+    "fsdp_tp",
+    "param_shardings",
+    "shard_init",
+    "strategy",
+    "tensor_parallel",
+    "zero1",
+    "zero2",
+]
