@@ -60,13 +60,14 @@ DEFAULT_RULES: tuple[tuple[str, PartitionSpec], ...] = (
     # MLA low-rank projections.
     (r"(q_down|kv_down)/kernel$", P("fsdp", None)),
     (r"(q_up|k_up|v_up)/kernel$", P("fsdp", "model")),
-    # MLP: column-parallel in, row-parallel out.
-    (r"(fc_in|gate_proj|up_proj)/kernel$", P("fsdp", "model")),
-    (r"(fc_out|down_proj)/kernel$", P("model", "fsdp")),
     # MoE experts: stacked (n_expert, ...) — expert axis first, then TP.
+    # Must precede the generic MLP rules (first match wins).
     (r"experts.*(fc_in|gate_proj|up_proj)/kernel$", P("expert", "fsdp", "model")),
     (r"experts.*(fc_out|down_proj)/kernel$", P("expert", "model", "fsdp")),
     (r"router/kernel$", P("fsdp", None)),
+    # MLP: column-parallel in, row-parallel out.
+    (r"(fc_in|gate_proj|up_proj)/kernel$", P("fsdp", "model")),
+    (r"(fc_out|down_proj)/kernel$", P("model", "fsdp")),
     # LM head (embed -> vocab).
     (r"lm_head/kernel$", P("model", "fsdp")),
     # Everything else (biases, layernorms) replicated by the no-match default.
@@ -182,18 +183,30 @@ class Strategy:
         """
         opt_rules = self.rules if self.zero_stage >= 1 else self.effective_rules()
 
-        flat_params = {
-            _path_str(p): spec_for(_path_str(p), jnp.shape(v), mesh, opt_rules)
-            for p, v in jax.tree_util.tree_flatten_with_path(params)[0]
-        }
+        # Param path -> (shape, spec), longest path first so a nested
+        # ".../decoder/proj/kernel" never binds to a shorter "proj/kernel".
+        flat_params = sorted(
+            (
+                (_path_str(p), jnp.shape(v))
+                for p, v in jax.tree_util.tree_flatten_with_path(params)[0]
+            ),
+            key=lambda kv: -len(kv[0]),
+        )
+        flat_specs = [
+            (path, shape, spec_for(path, shape, mesh, opt_rules))
+            for path, shape in flat_params
+        ]
 
         def leaf(path, x):
             ps = _path_str(path)
-            # optimizer pytrees embed the param path as a suffix (e.g.
-            # ".../mu/block_0/attn/q_proj/kernel")
-            for param_path, spec in flat_params.items():
-                if ps.endswith(param_path) and jnp.shape(x):
-                    return NamedSharding(mesh, spec)
+            # Optimizer pytrees (optax mu/nu etc.) embed the param path as a
+            # "/"-bounded suffix, e.g. ".../mu/block_0/attn/q_proj/kernel".
+            if jnp.shape(x):
+                for param_path, shape, spec in flat_specs:
+                    if jnp.shape(x) == shape and (
+                        ps == param_path or ps.endswith("/" + param_path)
+                    ):
+                        return NamedSharding(mesh, spec)
             return NamedSharding(mesh, P())
 
         return jax.tree_util.tree_map_with_path(leaf, opt_state)

@@ -126,7 +126,22 @@ def test_fit_spec_falls_back_on_indivisible(devices):
     """Rules degrade to replication when a dim doesn't divide the axis."""
     mesh = S.fsdp().build_mesh(devices)
     spec = S.spec_for("block_0/attn/q_proj/kernel", (6, 64), mesh, S.DEFAULT_RULES)
-    assert spec == P(None, "model") or spec == P()  # 6 % 8 != 0 → dim 0 dropped
+    # 6 % 8 != 0 → fsdp entry dropped; model axis (size 1) divides 64 → kept
+    assert spec == P(None, "model")
+
+
+def test_expert_rules_not_shadowed(devices):
+    """MoE expert kernels must pick up the 3-entry expert spec, not the
+    generic 2-entry MLP spec (rule order matters: first match wins)."""
+    mesh = S.expert_parallel(expert=2, fsdp_size=2, data=2).build_mesh(devices)
+    spec = S.spec_for(
+        "block_0/moe/experts/fc_in/kernel", (2, 64, 128), mesh, S.DEFAULT_RULES
+    )
+    assert spec == P("expert", "fsdp", "model")
+    spec_out = S.spec_for(
+        "block_0/moe/experts/fc_out/kernel", (2, 128, 64), mesh, S.DEFAULT_RULES
+    )
+    assert spec_out == P("expert", "model", "fsdp")
 
 
 def test_by_name():
