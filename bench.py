@@ -43,8 +43,11 @@ def main() -> None:
     )
     step = make_train_step()
 
+    # keep the global batch divisible by the batch-sharded mesh axes
+    n_batch_shards = mesh.shape["data"] * mesh.shape["fsdp"]
+    batch_size = max(BATCH, n_batch_shards) // n_batch_shards * n_batch_shards
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)), jnp.int32)
+    x = jnp.asarray(rng.integers(0, VOCAB, (batch_size, SEQ)), jnp.int32)
     batch = (x, jnp.roll(x, -1, axis=1))
 
     with mesh:
@@ -58,7 +61,7 @@ def main() -> None:
         jax.block_until_ready(metrics["loss"])
         dt = (time.perf_counter() - t0) / ITERS
 
-    tok_s = BATCH * SEQ / dt
+    tok_s = batch_size * SEQ / dt
     print(json.dumps({
         "metric": "gptlike_train_tokens_per_sec",
         "value": round(tok_s, 1),

@@ -1,0 +1,25 @@
+from llm_in_practise_tpu.models.deepseek import (
+    DeepSeekConfig,
+    DeepSeekLike,
+    deepseeklike_config,
+    moe_loss_fn,
+)
+from llm_in_practise_tpu.models.gpt import (
+    GPT,
+    GPTConfig,
+    gptlike_config,
+    minigpt_config,
+    minigpt_v1_config,
+)
+
+__all__ = [
+    "GPT",
+    "GPTConfig",
+    "DeepSeekConfig",
+    "DeepSeekLike",
+    "deepseeklike_config",
+    "gptlike_config",
+    "minigpt_config",
+    "minigpt_v1_config",
+    "moe_loss_fn",
+]

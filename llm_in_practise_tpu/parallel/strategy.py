@@ -64,7 +64,9 @@ DEFAULT_RULES: tuple[tuple[str, PartitionSpec], ...] = (
     # Must precede the generic MLP rules (first match wins).
     (r"experts.*(fc_in|gate_proj|up_proj)/kernel$", P("expert", "fsdp", "model")),
     (r"experts.*(fc_out|down_proj)/kernel$", P("expert", "model", "fsdp")),
-    (r"router/kernel$", P("fsdp", None)),
+    # Router kernel (d_model × n_experts) is tiny; sharding it forces an
+    # involuntary rematerialization in the partitioner — keep it replicated.
+    (r"router/kernel$", P()),
     # MLP: column-parallel in, row-parallel out.
     (r"(fc_in|gate_proj|up_proj)/kernel$", P("fsdp", "model")),
     (r"(fc_out|down_proj)/kernel$", P("model", "fsdp")),

@@ -1,0 +1,144 @@
+"""DeepSeekLike (RoPE + MLA + sparse MoE) golden tests.
+
+Mirrors the reference's implicit checks (output-shape asserts —
+``minigpt2/test_model.py:59-66``) and adds the math/infra tests the
+reference lacks: cache-vs-forward parity, routing mass conservation,
+expert-parallel training on the virtual mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from llm_in_practise_tpu.core import mesh as mesh_lib
+from llm_in_practise_tpu.models.deepseek import (
+    DeepSeekConfig,
+    DeepSeekLike,
+    MoEFeedForward,
+    deepseeklike_config,
+    moe_loss_fn,
+)
+from llm_in_practise_tpu.parallel import strategy as S
+from llm_in_practise_tpu.train.step import make_train_step
+
+VOCAB = 96
+
+
+def small_config(**kw):
+    base = dict(
+        seq_len=32, n_layer=2, n_head=4, embed_dim=64,
+        n_experts=4, top_k=2, n_shared_experts=1, dropout=0.0,
+        first_dense_layers=1,
+    )
+    base.update(kw)
+    return deepseeklike_config(VOCAB, **base)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = small_config()
+    model = DeepSeekLike(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    return model, cfg, params
+
+
+def test_forward_shape(model_and_params):
+    model, cfg, params = model_and_params
+    x = jnp.asarray(np.random.default_rng(0).integers(0, VOCAB, (2, 16)), jnp.int32)
+    logits = model.apply({"params": params}, x)
+    assert logits.shape == (2, 16, VOCAB)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_forward_jits(model_and_params):
+    model, cfg, params = model_and_params
+    f = jax.jit(lambda p, x: model.apply({"params": p}, x))
+    x = jnp.ones((2, 16), jnp.int32)
+    assert f(params, x).shape == (2, 16, VOCAB)
+
+
+@pytest.mark.parametrize("cache_mode", ["latent", "full"])
+def test_cached_decode_matches_forward(cache_mode):
+    """Prefill+decode through the cache must reproduce the uncached forward
+    logits — the correctness contract of the MLA latent cache."""
+    cfg = small_config(cache_mode=cache_mode)
+    model = DeepSeekLike(cfg)
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((1, 8), jnp.int32))["params"]
+    x = jnp.asarray(np.random.default_rng(1).integers(0, VOCAB, (2, 12)), jnp.int32)
+
+    full_logits = model.apply({"params": params}, x)
+
+    cache = model.init_cache(2, 16, dtype=jnp.float32)
+    logits_p, cache = model.apply({"params": params}, x[:, :8], cache=cache)
+    step_logits = [logits_p[:, -1]]
+    for t in range(8, 12):
+        lg, cache = model.apply({"params": params}, x[:, t : t + 1], cache=cache)
+        step_logits.append(lg[:, -1])
+    # cached decode logits at positions 7..11 == uncached forward
+    got = jnp.stack(step_logits, axis=1)
+    want = full_logits[:, 7:12]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_latent_cache_is_compressed():
+    cfg = small_config(cache_mode="latent")
+    model = DeepSeekLike(cfg)
+    cache = model.init_cache(2, 32)
+    assert cache[0]["kv"].shape == (2, 32, cfg.kv_rank_)
+    assert cfg.kv_rank_ < 2 * cfg.n_head * cfg.head_dim  # smaller than k+v
+
+
+def test_moe_routing_mass_and_aux():
+    """Gates renormalize over top-k (reference parity:
+    DeepSeekLike_spare_MoE_wikitext2.py:278-287) and aux loss is sown."""
+    cfg = small_config(capacity_factor=4.0)  # ample capacity: nothing dropped
+    moe = MoEFeedForward(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, cfg.embed_dim))
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    out, mut = moe.apply({"params": params}, x, mutable=["losses"])
+    assert out.shape == x.shape
+    (aux,) = jax.tree_util.tree_leaves(mut["losses"])
+    # balance term is ≥ k (perfect balance ⇒ E·k/E·(1/E)·E = k scaled) and finite
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_moe_capacity_drops_tokens_gracefully():
+    cfg = small_config(capacity_factor=0.1)  # force drops
+    moe = MoEFeedForward(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, cfg.embed_dim))
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    out = moe.apply({"params": params}, x, deterministic=False)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_train_step_decreases_loss(devices):
+    cfg = small_config()
+    model = DeepSeekLike(cfg)
+    strat = S.expert_parallel(expert=4, fsdp_size=2, data=1)
+    mesh = strat.build_mesh(devices)
+    state = S.shard_init(
+        model, strat, mesh, optax.adamw(1e-3),
+        jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32),
+    )
+    # experts actually sharded over the expert axis
+    w = state.params["block_1"]["moe"]["experts"]["fc_in"]["kernel"]
+    assert w.sharding.spec[0] == "expert"
+
+    step = make_train_step(loss_fn=moe_loss_fn)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, VOCAB, (8, 32)), jnp.int32)
+    batch = (x, jnp.roll(x, -1, 1))
+    with mesh:
+        b = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
+        state, m1 = step(state, b)
+        for _ in range(3):
+            state, m2 = step(state, b)
+    assert float(m2["ce_loss"]) < float(m1["ce_loss"])
+    assert np.isfinite(float(m2["moe_aux"]))
+
+
+def test_config_roundtrip():
+    cfg = small_config()
+    assert DeepSeekConfig.from_dict(cfg.to_dict()) == cfg
