@@ -71,6 +71,20 @@ def process_index() -> int:
     return jax.process_index()
 
 
+def process_count() -> int:
+    return jax.process_count()
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (``dist.barrier()`` parity
+    — reference ``temp/ddp_gpt_bpe_tokenizer_02.py:180``). Compiled as a tiny
+    global collective; no-op for single process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def is_coordinator() -> bool:
     """True on the process that should do filesystem writes / logging.
 

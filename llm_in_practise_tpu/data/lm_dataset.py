@@ -1,0 +1,127 @@
+"""Language-model datasets: block chunking, splits, and corpus prep.
+
+Behavioral parity with the reference's data layer (SURVEY §2.2):
+
+- block-chunk LM dataset — concatenate all token ids, truncate to a multiple
+  of ``block_size``, reshape to ``(-1, block)``; each example is the shifted
+  pair ``(block[:-1], block[1:])`` (reference ``ddp_basics/ddp_gpt_wikitext2.py:56-81``,
+  batch-encoded variant ``DeepSeekLike_spare_MoE_wikitext2.py:86-125``).
+- seeded train/val split (reference ``temp/ddp_gpt_bpe_tokenizer_02.py:262-300``
+  ``random_split`` + ``torch.Generator().manual_seed``).
+- ``prepare_data`` — wikitext-style corpus load with empty-line filtering
+  (reference ``ddp_gpt_wikitext2.py:45-51``); works from local files or the
+  HF ``datasets`` hub when reachable, with a deterministic synthetic fallback
+  so everything runs hermetically (this environment has zero egress).
+
+All outputs are host numpy int32; device placement/sharding happens in the
+train step via NamedSharding.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections.abc import Sequence
+
+import numpy as np
+
+
+def prepare_data(
+    dataset: str = "wikitext-2",
+    split: str = "train",
+    *,
+    local_path: str | None = None,
+    synthetic_lines: int = 20000,
+) -> list[str]:
+    """Load a text corpus as filtered non-empty lines.
+
+    Resolution order: explicit ``local_path`` file → HF ``datasets`` cache/hub
+    (``wikitext-2`` / ``wikitext-103``) → deterministic synthetic corpus. The
+    reference assumes hub access (``ddp_gpt_wikitext2.py:45-51``); here the
+    synthetic path keeps tests and examples hermetic.
+    """
+    if local_path is not None:
+        with open(local_path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        return [ln for ln in lines if ln.strip()]
+    if dataset.startswith("wikitext"):
+        try:
+            import datasets as hf_datasets
+
+            name = "wikitext-103-raw-v1" if "103" in dataset else "wikitext-2-raw-v1"
+            ds = hf_datasets.load_dataset(
+                "wikitext", name, split=split, download_mode="reuse_cache_if_exists"
+            )
+            return [t for t in ds["text"] if t.strip()]
+        except Exception:
+            pass
+    return synthetic_corpus(n_lines=synthetic_lines, seed=_stable_seed(dataset, split))
+
+
+def _stable_seed(*parts: str) -> int:
+    h = hashlib.sha256("|".join(parts).encode()).digest()
+    return int.from_bytes(h[:4], "little")
+
+
+_SYN_VOCAB = (
+    "the of and to a in model training data loss gradient layer attention "
+    "head expert token batch step learning rate optimizer shard mesh device "
+    "compile kernel memory bandwidth matrix product norm residual embedding "
+    "sequence cache decode sample epoch checkpoint resume metric eval test"
+).split()
+
+
+def synthetic_corpus(n_lines: int = 20000, seed: int = 0) -> list[str]:
+    """Deterministic pseudo-natural corpus (Zipf-ish word draw) for hermetic
+    runs — stands in for wikitext when the hub is unreachable."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, len(_SYN_VOCAB) + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / (1.0 / ranks).sum()
+    lines = []
+    lengths = rng.integers(5, 40, size=n_lines)
+    for ln in lengths:
+        idx = rng.choice(len(_SYN_VOCAB), size=int(ln), p=probs)
+        words = [_SYN_VOCAB[i] for i in idx]
+        words[0] = words[0].capitalize()
+        lines.append(" ".join(words) + ".")
+    return lines
+
+
+def block_chunk(
+    token_ids: Sequence[int] | np.ndarray, block_size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Concatenate→truncate→reshape→shift: returns ``(x, y)`` of shape
+    ``(n_blocks, block_size - 1)`` with ``y`` the next-token targets.
+
+    Matches the reference exactly: ids truncated to a multiple of
+    ``block_size``, viewed as rows, each row split into input ``[:-1]`` and
+    target ``[1:]`` (``ddp_gpt_wikitext2.py:62-77``).
+    """
+    ids = np.asarray(token_ids, dtype=np.int32)
+    n_blocks = len(ids) // block_size
+    if n_blocks == 0:
+        raise ValueError(
+            f"corpus of {len(ids)} tokens too small for block_size {block_size}"
+        )
+    blocks = ids[: n_blocks * block_size].reshape(n_blocks, block_size)
+    return blocks[:, :-1].copy(), blocks[:, 1:].copy()
+
+
+def tokenize_corpus(texts: Sequence[str], tokenizer, *, join: str = "\n") -> np.ndarray:
+    """Encode a whole corpus to one flat id array (batch-encode parity with
+    ``TokenizedDataset.__init__`` — ``DeepSeekLike_spare_MoE_wikitext2.py:92-109``)."""
+    ids: list[int] = []
+    for text in texts:
+        ids.extend(tokenizer.encode(text + join))
+    if not ids:
+        raise ValueError("empty dataset after tokenization")
+    return np.asarray(ids, dtype=np.int32)
+
+
+def train_val_split(
+    n: int, val_fraction: float = 0.1, *, seed: int = 42
+) -> tuple[np.ndarray, np.ndarray]:
+    """Seeded permutation split (``random_split`` + manual_seed parity —
+    ``temp/ddp_gpt_bpe_tokenizer_02.py:262-300``). Returns (train_idx, val_idx)."""
+    perm = np.random.default_rng(seed).permutation(n)
+    n_val = max(1, int(n * val_fraction)) if 0 < val_fraction < 1 else 0
+    return perm[n_val:], perm[:n_val]

@@ -145,12 +145,20 @@ class MLA(nn.Module):
 
         k_up = dense(h * hd, "k_up")
         v_up = dense(h * hd, "v_up")
-        cos, sin = rope_ops.precompute_cos_sin(hd, cfg.seq_len, cfg.rope_theta)
+        # RoPE tables must cover the cache length, which may exceed seq_len
+        # (init_cache(max_len=...)); otherwise position gathers past the table
+        # would clamp silently and corrupt phases.
+        table_len = cfg.seq_len
+        if cache is not None:
+            table_len = max(
+                table_len,
+                (cache["kv"] if "kv" in cache else cache["k"]).shape[1],
+            )
+        cos, sin = rope_ops.precompute_cos_sin(hd, table_len, cfg.rope_theta)
 
         q = rope_ops.apply_rotary_emb(q, cos, sin, positions=positions)
 
         q_offset = None
-        kv_length = None
         if cache is None:
             k = k_up(kv_latent).reshape(b, l, h, hd)
             v = v_up(kv_latent).reshape(b, l, h, hd)
@@ -192,7 +200,6 @@ class MLA(nn.Module):
             q, k, v,
             causal=True,
             q_offset=q_offset,
-            kv_length=kv_length,
             dropout_rate=0.0 if deterministic else cfg.dropout,
             dropout_rng=dropout_rng,
             impl=cfg.attn_impl,
@@ -269,33 +276,45 @@ class MoEFeedForward(nn.Module):
         self.sow("losses", "moe_aux",
                  cfg.aux_loss_coef * balance + cfg.z_loss_coef * z_loss)
 
-        # Capacity-based dispatch with first-choice priority: flatten (k, N)
-        # slot-major so every token's 1st choice outranks all 2nd choices.
-        # Inference (deterministic) uses drop-free capacity so cached decode
-        # reproduces the full forward exactly regardless of batch shape.
-        if deterministic:
-            capacity = n_tok
-        else:
-            capacity = max(1, int(cfg.capacity_factor * n_tok * k / e))
-        flat = sel_onehot.transpose(1, 0, 2).reshape(k * n_tok, e)      # (kN, E)
-        pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0                # rank in expert
-        pos = pos_flat.reshape(k, n_tok, e).transpose(1, 0, 2)          # (N, k, E)
-        keep = (pos >= 0) & (pos < capacity)
-        pos = jnp.where(keep, pos, 0).astype(jnp.int32)
-        # dispatch[n, k, e, c] — one-hot over capacity slot
-        dispatch = sel_onehot[..., None] * keep[..., None] * jax.nn.one_hot(
-            pos, capacity, dtype=jnp.float32
-        )                                                               # (N, k, E, C)
-        dispatch_nec = dispatch.sum(1)                                  # (N, E, C)
-        combine = (dispatch * gate_vals[..., None, None]).sum(1)        # (N, E, C)
-
-        expert_inputs = jnp.einsum(
-            "nec,nd->ecd", dispatch_nec.astype(x.dtype), tokens
-        )
-        expert_out = StackedExperts(
+        experts = StackedExperts(
             e, d, cfg.expert_hidden_, cfg.activation, name="experts"
-        )(expert_inputs)
-        routed = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+        )
+        if deterministic:
+            # Drop-free dense routing for eval/decode: every expert runs over
+            # all tokens and the (N, E) gate matrix combines. O(N·E) memory —
+            # no capacity buffer — and exact (nothing dropped), so cached
+            # decode reproduces the full forward regardless of batch shape.
+            gates_dense = (sel_onehot * gate_vals[..., None]).sum(1)    # (N, E)
+            expert_inputs = jnp.broadcast_to(tokens[None], (e, n_tok, d))
+            expert_out = experts(expert_inputs)                         # (E, N, D)
+            routed = jnp.einsum(
+                "ne,end->nd", gates_dense.astype(x.dtype), expert_out
+            )
+        else:
+            # Training: capacity-based dispatch with first-choice priority —
+            # flatten (k, N) slot-major so every token's 1st choice outranks
+            # all 2nd choices; overflow tokens are dropped (gate mass lost),
+            # the standard static-shape TPU MoE trade.
+            capacity = max(1, int(cfg.capacity_factor * n_tok * k / e))
+            flat = sel_onehot.transpose(1, 0, 2).reshape(k * n_tok, e)  # (kN, E)
+            pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0            # rank in expert
+            pos = pos_flat.reshape(k, n_tok, e).transpose(1, 0, 2)      # (N, k, E)
+            keep = (pos >= 0) & (pos < capacity)
+            pos = jnp.where(keep, pos, 0).astype(jnp.int32)
+            # dispatch[n, k, e, c] — one-hot over capacity slot
+            dispatch = sel_onehot[..., None] * keep[..., None] * jax.nn.one_hot(
+                pos, capacity, dtype=jnp.float32
+            )                                                           # (N, k, E, C)
+            dispatch_nec = dispatch.sum(1)                              # (N, E, C)
+            combine = (dispatch * gate_vals[..., None, None]).sum(1)    # (N, E, C)
+
+            expert_inputs = jnp.einsum(
+                "nec,nd->ecd", dispatch_nec.astype(x.dtype), tokens
+            )
+            expert_out = experts(expert_inputs)                         # (E, C, D)
+            routed = jnp.einsum(
+                "nec,ecd->nd", combine.astype(x.dtype), expert_out
+            )
 
         out = routed.reshape(b, l, d)
         for i in range(cfg.n_shared_experts):

@@ -1,0 +1,162 @@
+"""Data layer tests: BPE tokenizer, block chunking, SFT label masking.
+
+Mirrors the reference's (thin) verification style but makes it systematic:
+roundtrip/determinism for the tokenizer, exact shift semantics for block
+chunking (``ddp_gpt_wikitext2.py:62-77``), and −100 masking span checks for
+SFT (``qwen3-8b-lora.py:62-99``).
+"""
+
+import numpy as np
+import pytest
+
+from llm_in_practise_tpu.data.bpe import BPETokenizer
+from llm_in_practise_tpu.data.lm_dataset import (
+    block_chunk,
+    prepare_data,
+    synthetic_corpus,
+    tokenize_corpus,
+    train_val_split,
+)
+from llm_in_practise_tpu.data.sft import (
+    IGNORE_INDEX,
+    build_sft_dataset,
+    render_chatml,
+    self_cognition_records,
+    tokenize_for_sft,
+)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the lazy dog sleeps while the quick fox runs",
+    "pack my box with five dozen liquor jugs",
+    "how vexingly quick daft zebras jump!",
+] * 8
+
+
+@pytest.fixture(scope="module")
+def bpe():
+    return BPETokenizer.train(CORPUS, vocab_size=300, min_frequency=2)
+
+
+class TestBPE:
+    def test_roundtrip(self, bpe):
+        for text in ["the quick brown fox", "zebras jump!", "dozen liquor jugs"]:
+            assert bpe.decode(bpe.encode(text)) == text
+
+    def test_roundtrip_unicode(self, bpe):
+        # byte-level alphabet covers all of UTF-8, even unseen chars
+        text = "héllo wörld 你好"
+        assert bpe.decode(bpe.encode(text)) == text
+
+    def test_merges_actually_compress(self, bpe):
+        ids = bpe.encode("the quick brown fox")
+        assert len(ids) < len("the quick brown fox".encode())
+
+    def test_special_tokens_atomic(self, bpe):
+        ids = bpe.encode("[CLS]the fox[SEP]")
+        assert ids[0] == bpe.token_to_id("[CLS]")
+        assert ids[-1] == bpe.token_to_id("[SEP]")
+
+    def test_determinism(self):
+        a = BPETokenizer.train(CORPUS, vocab_size=300)
+        b = BPETokenizer.train(CORPUS, vocab_size=300)
+        assert a.vocab == b.vocab and a.merges == b.merges
+
+    def test_save_load(self, bpe, tmp_path):
+        path = str(tmp_path / "tok.json")
+        bpe.save(path)
+        loaded = BPETokenizer.load(path)
+        assert loaded.vocab == bpe.vocab
+        text = "the quick brown fox"
+        assert loaded.encode(text) == bpe.encode(text)
+
+    def test_whitespace_pretok(self):
+        tok = BPETokenizer.train(CORPUS, vocab_size=300, pre_tokenizer="whitespace")
+        ids = tok.encode("the quick fox")
+        assert ids and tok.decode(ids) == "thequickfox"  # whitespace not preserved
+
+    def test_special_token_ids_first(self, bpe):
+        assert bpe.token_to_id("[PAD]") == 0
+        assert bpe.token_to_id("[UNK]") == 1
+
+
+class TestBlockChunk:
+    def test_shift_semantics(self):
+        ids = np.arange(20)
+        x, y = block_chunk(ids, block_size=5)
+        assert x.shape == (4, 4) and y.shape == (4, 4)
+        np.testing.assert_array_equal(y, x + 1)  # next-token shift
+        np.testing.assert_array_equal(x[0], [0, 1, 2, 3])
+
+    def test_truncation_to_multiple(self):
+        x, _ = block_chunk(np.arange(23), block_size=5)
+        assert x.shape[0] == 4  # 23 // 5
+
+    def test_too_small_raises(self):
+        with pytest.raises(ValueError):
+            block_chunk(np.arange(3), block_size=5)
+
+    def test_tokenize_corpus(self, bpe):
+        flat = tokenize_corpus(CORPUS[:4], bpe)
+        assert flat.dtype == np.int32 and flat.ndim == 1 and len(flat) > 20
+
+
+class TestSplitsAndCorpus:
+    def test_split_seeded(self):
+        tr1, va1 = train_val_split(100, 0.1, seed=7)
+        tr2, va2 = train_val_split(100, 0.1, seed=7)
+        np.testing.assert_array_equal(tr1, tr2)
+        assert len(va1) == 10 and len(set(tr1) & set(va1)) == 0
+
+    def test_synthetic_corpus_deterministic(self):
+        assert synthetic_corpus(50, seed=1) == synthetic_corpus(50, seed=1)
+
+    def test_prepare_data_fallback(self):
+        lines = prepare_data("wikitext-2", synthetic_lines=100)
+        assert len(lines) > 0 and all(ln.strip() for ln in lines)
+
+
+class TestSFT:
+    def test_render_chatml(self):
+        msgs = [
+            {"role": "system", "content": "sys"},
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "hello"},
+        ]
+        text = render_chatml(msgs)
+        assert text.startswith("<|im_start|>system\nsys<|im_end|>")
+        assert "<|im_start|>assistant\nhello<|im_end|>" in text
+
+    def test_label_masking_span(self, bpe):
+        records = self_cognition_records(4)
+        batch = build_sft_dataset(records, bpe, name="TestBot", author="TestTeam",
+                                  max_length=256)
+        assert batch.input_ids.shape == (4, 256)
+        for i in range(4):
+            labs = batch.labels[i]
+            valid = labs != IGNORE_INDEX
+            assert valid.any(), "assistant span must be supervised"
+            # prompt prefix (incl. system+user) is masked
+            assert labs[0] == IGNORE_INDEX
+            # valid region is one contiguous span
+            idx = np.flatnonzero(valid)
+            assert np.all(np.diff(idx) == 1)
+            # supervised tokens equal the input ids there
+            np.testing.assert_array_equal(
+                batch.input_ids[i][valid], labs[valid]
+            )
+
+    def test_placeholder_substitution(self, bpe):
+        records = [{"query": "Who are you?",
+                    "response": "I am {{NAME}} by {{AUTHOR}}.", "tag": "en"}]
+        batch = build_sft_dataset(records, bpe, name="Zeta", author="Org")
+        decoded = bpe.decode(batch.input_ids[0][batch.attention_mask[0] == 1])
+        assert "Zeta" in decoded and "Org" in decoded and "{{NAME}}" not in decoded
+
+    def test_padding_and_mask_agree(self, bpe):
+        batch = tokenize_for_sft(
+            ["<|im_start|>user\nhi<|im_end|>\n<|im_start|>assistant\nyo<|im_end|>"],
+            bpe, max_length=64,
+        )
+        n_real = int(batch.attention_mask[0].sum())
+        assert (batch.input_ids[0][n_real:] == bpe.pad_id).all()

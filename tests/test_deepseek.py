@@ -93,7 +93,7 @@ def test_latent_cache_is_compressed():
 def test_moe_routing_mass_and_aux():
     """Gates renormalize over top-k (reference parity:
     DeepSeekLike_spare_MoE_wikitext2.py:278-287) and aux loss is sown."""
-    cfg = small_config(capacity_factor=4.0)  # ample capacity: nothing dropped
+    cfg = small_config(capacity_factor=4.0, dropout=0.0)
     moe = MoEFeedForward(cfg)
     x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, cfg.embed_dim))
     params = moe.init(jax.random.PRNGKey(0), x)["params"]
@@ -102,6 +102,13 @@ def test_moe_routing_mass_and_aux():
     (aux,) = jax.tree_util.tree_leaves(mut["losses"])
     # balance term is ≥ k (perfect balance ⇒ E·k/E·(1/E)·E = k scaled) and finite
     assert np.isfinite(float(aux)) and float(aux) > 0
+    # ample capacity + no dropout ⇒ the capacity-dispatch train path computes
+    # the same routing as the dense drop-free eval path
+    out_cap, _ = moe.apply(
+        {"params": params}, x, deterministic=False, mutable=["losses"],
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_cap), atol=1e-5)
 
 
 def test_moe_capacity_drops_tokens_gracefully():
