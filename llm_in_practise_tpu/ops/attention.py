@@ -98,11 +98,12 @@ def dot_product_attention(
 ) -> jax.Array:
     """Attention entry point used by every model in the framework."""
     if impl == "auto":
-        impl = _pick_impl(q, bias, kv_length, dropout_rate)
+        impl = _pick_impl(q, bias, kv_length, dropout_rate, causal)
     if impl == "flash":
         from llm_in_practise_tpu.ops import flash_attention as fa
 
-        if bias is None and kv_length is None and dropout_rate == 0.0 and q_offset is None:
+        if (causal and bias is None and kv_length is None
+                and dropout_rate == 0.0 and q_offset is None):
             return fa.flash_attention(q, k, v, causal=causal, scale=scale)
         impl = "dense"  # flash kernel doesn't cover these yet
     return dense_attention(
@@ -130,10 +131,11 @@ def _flash_available() -> bool:
         return False
 
 
-def _pick_impl(q, bias, kv_length, dropout_rate) -> str:
+def _pick_impl(q, bias, kv_length, dropout_rate, causal=True) -> str:
     if (
         not _on_tpu()
         or not _flash_available()
+        or not causal
         or bias is not None
         or kv_length is not None
         or dropout_rate
