@@ -98,12 +98,13 @@ def dot_product_attention(
 ) -> jax.Array:
     """Attention entry point used by every model in the framework."""
     if impl == "auto":
-        impl = _pick_impl(q, bias, kv_length, dropout_rate, causal)
+        impl = _pick_impl(q, k, bias, kv_length, dropout_rate, causal)
     if impl == "flash":
         from llm_in_practise_tpu.ops import flash_attention as fa
 
         if (causal and bias is None and kv_length is None
-                and dropout_rate == 0.0 and q_offset is None):
+                and dropout_rate == 0.0 and q_offset is None
+                and k.shape == q.shape):
             return fa.flash_attention(q, k, v, causal=causal, scale=scale)
         impl = "dense"  # flash kernel doesn't cover these yet
     return dense_attention(
@@ -131,7 +132,7 @@ def _flash_available() -> bool:
         return False
 
 
-def _pick_impl(q, bias, kv_length, dropout_rate, causal=True) -> str:
+def _pick_impl(q, k, bias, kv_length, dropout_rate, causal=True) -> str:
     if (
         not _on_tpu()
         or not _flash_available()
@@ -139,6 +140,7 @@ def _pick_impl(q, bias, kv_length, dropout_rate, causal=True) -> str:
         or bias is not None
         or kv_length is not None
         or dropout_rate
+        or k.shape != q.shape
     ):
         return "dense"
     _, q_len, _, head_dim = q.shape

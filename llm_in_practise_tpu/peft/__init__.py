@@ -1,0 +1,27 @@
+from llm_in_practise_tpu.peft.lora import (
+    LoRAConfig,
+    apply_lora,
+    init_lora,
+    merge_lora,
+    target_paths,
+    trainable_report,
+)
+from llm_in_practise_tpu.peft.qlora import (
+    make_qlora_loss_fn,
+    memory_report,
+    qlora_apply,
+    quantize_base,
+)
+
+__all__ = [
+    "LoRAConfig",
+    "apply_lora",
+    "init_lora",
+    "make_qlora_loss_fn",
+    "memory_report",
+    "merge_lora",
+    "qlora_apply",
+    "quantize_base",
+    "target_paths",
+    "trainable_report",
+]

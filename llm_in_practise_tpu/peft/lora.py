@@ -1,0 +1,124 @@
+"""LoRA — low-rank adaptation as a functional param-pytree transform.
+
+Parity with the reference's peft usage (``Fine-Tuning/qwen3-8b-lora.py:122-144``:
+``LoraConfig(r=16, lora_alpha=32, target_modules=[q/k/v/o_proj], dropout 0.05)``,
+trainable-param report ``:151-152``; adapter merge
+``Scripts/fine-tuning/02-merge-lora-adapter-and-model.py:27-38``
+``merge_and_unload()``), designed the JAX way: instead of wrapping modules,
+LoRA factors live in their own pytree and the *effective* kernel
+``W + (alpha/r)·A@B`` is materialized inside the jitted step — XLA fuses the
+rank-r update into the matmul schedule, gradients flow only to ``A``/``B``
+(the base tree is a constant of the loss), and ``merge`` is the same
+expression evaluated once on host.
+
+Works on any param pytree — the in-tree GPT/DeepSeek/Qwen models and
+HF-imported checkpoints alike. Adapter-only checkpoints are just the small
+LoRA tree (reference tier-5 saves, SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.utils.tree import flatten_with_paths, path_str
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """r/alpha/targets mirror the reference's LoraConfig surface."""
+
+    r: int = 8
+    alpha: float = 16.0
+    dropout: float = 0.0  # reserved; rank-update form has no activation hook
+    target_patterns: tuple[str, ...] = ("q_proj", "v_proj")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.r
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LoRAConfig":
+        d = dict(d)
+        if "target_patterns" in d:
+            d["target_patterns"] = tuple(d["target_patterns"])
+        return cls(**d)
+
+
+def target_paths(params, cfg: LoRAConfig) -> list[str]:
+    """All 2-D kernel paths matching any target pattern (regex or substring)."""
+    pats = [re.compile(p) for p in cfg.target_patterns]
+    out = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        s = path_str(path)
+        if getattr(leaf, "ndim", 0) == 2 and any(p.search(s) for p in pats):
+            out.append(s)
+    return sorted(out)
+
+
+def init_lora(
+    params, cfg: LoRAConfig, rng: jax.Array, dtype=jnp.float32
+) -> dict[str, dict[str, jax.Array]]:
+    """LoRA tree {path: {a, b}}: ``a`` gaussian, ``b`` zeros — so the adapted
+    model is exactly the base model at step 0 (peft init parity)."""
+    paths = target_paths(params, cfg)
+    if not paths:
+        raise ValueError(
+            f"no 2-D kernels match target_patterns={cfg.target_patterns}"
+        )
+    by_path = flatten_with_paths(params)
+    tree = {}
+    for i, path in enumerate(paths):
+        d_in, d_out = by_path[path].shape
+        key = jax.random.fold_in(rng, i)
+        tree[path] = {
+            "a": jax.random.normal(key, (d_in, cfg.r), dtype) * 0.02,
+            "b": jnp.zeros((cfg.r, d_out), dtype),
+        }
+    return tree
+
+
+def apply_lora(params, lora_params: dict, cfg: LoRAConfig):
+    """Effective param tree: target kernels become ``W + scaling·A@B``.
+
+    Call inside the jitted loss — XLA constant-folds the base tree and
+    differentiates only through A/B.
+    """
+    def maybe_adapt(path, leaf):
+        s = path_str(path)
+        ab = lora_params.get(s)
+        if ab is None:
+            return leaf
+        delta = (ab["a"] @ ab["b"]) * cfg.scaling
+        return leaf + delta.astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(maybe_adapt, params)
+
+
+def merge_lora(params, lora_params: dict, cfg: LoRAConfig):
+    """``merge_and_unload()`` parity: one-time host-side materialization of
+    the adapted weights for export/serving."""
+    merged = jax.jit(lambda p, l: apply_lora(p, l, cfg))(params, lora_params)
+    return jax.device_get(merged)
+
+
+def count_params(tree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def trainable_report(params, lora_params) -> str:
+    """'trainable params: X || all params: Y || trainable%' — parity with the
+    reference's print_trainable_parameters check (``qwen3-8b-lora.py:151-152``)."""
+    n_lora = count_params(lora_params)
+    n_all = count_params(params) + n_lora
+    return (
+        f"trainable params: {n_lora:,} || all params: {n_all:,} || "
+        f"trainable%: {100 * n_lora / n_all:.4f}"
+    )

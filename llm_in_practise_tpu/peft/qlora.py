@@ -1,0 +1,64 @@
+"""QLoRA: NF4-quantized frozen base + trainable LoRA factors.
+
+Parity with the reference north-star fine-tune
+(``Fine-Tuning/qwen3-14b-qlora-dist-deepspeed.py:95-123``: 4-bit NF4 double-
+quant base, bf16 compute, ``prepare_model_for_kbit_training``, LoRA r=8 on
+q_proj/v_proj). TPU shape: the base tree is stored as NF4 (§quant/nf4) and
+dequantized to bf16 *inside the jitted step*, where XLA fuses the 16-entry
+codebook gather + scale into the consuming matmul; LoRA A/B stay fp32 and are
+the only differentiated leaves — so the optimizer state is rank-r small, the
+4-bit base is the only full-model memory, and there is no engine in sight.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.peft import lora as lora_lib
+from llm_in_practise_tpu.quant import nf4
+
+
+def quantize_base(params, *, min_size: int = 4096):
+    """NF4-quantize every 2-D kernel of ``min_size``+ elements.
+
+    Embedding/lm_head-sized and tiny kernels stay bf16 (the reference keeps
+    lm_head unquantized too — ``Quantization`` recipes ``ignore=["lm_head"]``).
+    """
+    def predicate(path, leaf):
+        if getattr(leaf, "ndim", 0) != 2 or leaf.size < min_size:
+            return False
+        return "embed" not in path and "lm_head" not in path
+
+    return nf4.quantize_tree(params, predicate)
+
+
+def qlora_apply(qparams, lora_params, cfg: lora_lib.LoRAConfig,
+                dtype=jnp.bfloat16):
+    """Effective bf16 param tree from NF4 base + LoRA delta.
+
+    Call inside the jitted loss: ``model.apply({"params": qlora_apply(...)})``.
+    Gradients flow only through ``lora_params`` (NF4 leaves are uint8 —
+    non-differentiable constants by construction).
+    """
+    base = nf4.dequantize_tree(qparams, dtype)
+    return lora_lib.apply_lora(base, lora_params, cfg)
+
+
+def make_qlora_loss_fn(model, qparams, cfg: lora_lib.LoRAConfig,
+                       base_loss_fn, dtype=jnp.bfloat16):
+    """Wrap a ``loss_fn(params, batch, rng)`` into one over LoRA params only."""
+    def loss_fn(lora_params, batch, rng):
+        params = qlora_apply(qparams, lora_params, cfg, dtype)
+        return base_loss_fn(params, batch, rng)
+
+    return loss_fn
+
+
+def memory_report(params, qparams) -> str:
+    full = nf4.tree_nbytes(params)
+    quant = nf4.tree_nbytes(qparams)
+    return (
+        f"base {full / 2**20:.1f} MiB -> NF4 {quant / 2**20:.1f} MiB "
+        f"({full / max(quant, 1):.2f}x smaller)"
+    )

@@ -1,0 +1,17 @@
+from llm_in_practise_tpu.quant.nf4 import (
+    NF4Tensor,
+    dequantize,
+    dequantize_tree,
+    quantize,
+    quantize_tree,
+    tree_nbytes,
+)
+
+__all__ = [
+    "NF4Tensor",
+    "dequantize",
+    "dequantize_tree",
+    "quantize",
+    "quantize_tree",
+    "tree_nbytes",
+]

@@ -1,0 +1,25 @@
+"""Pytree path utilities shared across peft/quant/parallel.
+
+Path-string formatting is a cross-module contract: LoRA target selection,
+NF4 quantization predicates, and sharding-rule matching all address params by
+the same "a/b/c" key-path strings.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def path_str(path) -> str:
+    """'a/b/c' form of a jax key path (DictKey/GetAttrKey/SequenceKey)."""
+    return "/".join(
+        p.key if hasattr(p, "key") else str(getattr(p, "idx", p)) for p in path
+    )
+
+
+def flatten_with_paths(tree) -> dict:
+    """{path_str: leaf} for every leaf."""
+    return {
+        path_str(p): leaf
+        for p, leaf in jax.tree_util.tree_leaves_with_path(tree)
+    }
