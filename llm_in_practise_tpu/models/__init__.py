@@ -11,15 +11,19 @@ from llm_in_practise_tpu.models.gpt import (
     minigpt_config,
     minigpt_v1_config,
 )
+from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config, qwen3_config
 
 __all__ = [
     "GPT",
     "GPTConfig",
     "DeepSeekConfig",
     "DeepSeekLike",
+    "Qwen3",
+    "Qwen3Config",
     "deepseeklike_config",
     "gptlike_config",
     "minigpt_config",
     "minigpt_v1_config",
     "moe_loss_fn",
+    "qwen3_config",
 ]

@@ -1,0 +1,270 @@
+"""Qwen3 architecture in flax — the HF-interop model family.
+
+Capability parity with the reference's fine-tuning targets (Qwen3-8B/14B and
+DeepSeek-R1-0528-Qwen3-8B, loaded via ``AutoModelForCausalLM`` in
+``Fine-Tuning/qwen3-8b-lora.py:114-120`` and
+``Fine-Tuning/qwen3-14b-qlora-dist-deepspeed.py:95-107``), built TPU-first:
+
+- GQA attention with per-head **QK-RMSNorm** (the Qwen3 signature), RoPE with
+  theta 1e6, SwiGLU MLP, RMSNorm everywhere, no biases.
+- KV cache stores only ``n_kv_head`` heads; the group-broadcast to ``n_head``
+  happens inside the jitted step where XLA fuses it into the attention einsum.
+- Everything static-shape; the same module serves training (no cache) and
+  KV-cached decode.
+
+Weights come from HF safetensors checkpoints via
+:mod:`llm_in_practise_tpu.models.hf_loader`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.ops import rope as rope_ops
+from llm_in_practise_tpu.ops.attention import dot_product_attention
+
+Cache = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen3Config:
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    n_layer: int
+    n_head: int
+    n_kv_head: int
+    head_dim: int
+    rope_theta: float = 1_000_000.0
+    rms_norm_eps: float = 1e-6
+    max_seq_len: int = 4096
+    tie_word_embeddings: bool = False
+    attn_impl: str = "auto"
+    compute_dtype: str = "bfloat16"
+
+    def replace(self, **kw) -> "Qwen3Config":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Qwen3Config":
+        return cls(**d)
+
+    @classmethod
+    def from_hf_config(cls, hf: dict, **overrides) -> "Qwen3Config":
+        """Build from a HF ``config.json`` dict (transformers Qwen3Config)."""
+        cfg = cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            n_layer=hf["num_hidden_layers"],
+            n_head=hf["num_attention_heads"],
+            n_kv_head=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get(
+                "head_dim", hf["hidden_size"] // hf["num_attention_heads"]
+            ),
+            rope_theta=float(hf.get("rope_theta", 1_000_000.0)),
+            rms_norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
+            max_seq_len=int(hf.get("max_position_embeddings", 4096)),
+            tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        )
+        return cfg.replace(**overrides)
+
+
+def qwen3_config(vocab_size: int = 1024, **kw) -> Qwen3Config:
+    """Tiny-default constructor for tests and examples."""
+    defaults = dict(
+        vocab_size=vocab_size, hidden_size=128, intermediate_size=256,
+        n_layer=2, n_head=4, n_kv_head=2, head_dim=32, max_seq_len=256,
+    )
+    defaults.update(kw)
+    return Qwen3Config(**defaults)
+
+
+class RMSNorm(nn.Module):
+    """RMSNorm with f32 accumulation (HF Qwen3RMSNorm semantics)."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        x = x * jax.lax.rsqrt(var + self.eps)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        return (x * scale).astype(dtype)
+
+
+def init_cache(
+    cfg: Qwen3Config, batch: int, max_len: int, dtype=jnp.bfloat16
+) -> list[Cache]:
+    """Static-shape per-layer KV cache holding only the KV-head groups."""
+    return [
+        {
+            "k": jnp.zeros((batch, max_len, cfg.n_kv_head, cfg.head_dim), dtype),
+            "v": jnp.zeros((batch, max_len, cfg.n_kv_head, cfg.head_dim), dtype),
+            "index": jnp.zeros((), jnp.int32),
+        }
+        for _ in range(cfg.n_layer)
+    ]
+
+
+class Qwen3Attention(nn.Module):
+    """GQA + QK-RMSNorm + RoPE causal attention."""
+
+    cfg: Qwen3Config
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        rope_tables: tuple[jax.Array, jax.Array],
+        *,
+        cache: Cache | None = None,
+        positions: jax.Array | None = None,
+    ) -> tuple[jax.Array, Cache | None]:
+        cfg = self.cfg
+        b, l, _ = x.shape
+        dense = lambda feats, name: nn.Dense(feats, use_bias=False, name=name)
+        q = dense(cfg.n_head * cfg.head_dim, "q_proj")(x)
+        k = dense(cfg.n_kv_head * cfg.head_dim, "k_proj")(x)
+        v = dense(cfg.n_kv_head * cfg.head_dim, "v_proj")(x)
+        q = q.reshape(b, l, cfg.n_head, cfg.head_dim)
+        k = k.reshape(b, l, cfg.n_kv_head, cfg.head_dim)
+        v = v.reshape(b, l, cfg.n_kv_head, cfg.head_dim)
+
+        # Qwen3 signature: per-head RMSNorm on q and k before RoPE.
+        q = RMSNorm(cfg.rms_norm_eps, name="q_norm")(q)
+        k = RMSNorm(cfg.rms_norm_eps, name="k_norm")(k)
+
+        cos, sin = rope_tables
+        if positions is None and cache is not None:
+            positions = cache["index"] + jnp.arange(l)[None, :]
+            positions = jnp.broadcast_to(positions, (b, l))
+        # HF rotate_half lane layout — required for checkpoint fidelity.
+        q = rope_ops.apply_rotary_emb(q, cos, sin, positions=positions, interleaved=False)
+        k = rope_ops.apply_rotary_emb(k, cos, sin, positions=positions, interleaved=False)
+
+        q_offset = None
+        if cache is not None:
+            q_offset = cache["index"]
+            k_cache = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, cache["index"], 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, cache["index"], 0, 0)
+            )
+            cache = {"k": k_cache, "v": v_cache, "index": cache["index"] + l}
+            k, v = k_cache.astype(q.dtype), v_cache.astype(q.dtype)
+
+        # Group broadcast: (B, L, Hkv, D) -> (B, L, H, D). XLA fuses the
+        # repeat into the attention contraction, so no HBM blowup.
+        groups = cfg.n_head // cfg.n_kv_head
+        if groups > 1:
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+
+        out = dot_product_attention(
+            q, k, v,
+            causal=True, q_offset=q_offset,
+            impl=cfg.attn_impl,
+        )
+        out = out.reshape(b, l, cfg.n_head * cfg.head_dim)
+        return dense(cfg.hidden_size, "out_proj")(out), cache
+
+
+class Qwen3MLP(nn.Module):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    cfg: Qwen3Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj")(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj")(x)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj")(
+            nn.silu(gate) * up
+        )
+
+
+class Qwen3Block(nn.Module):
+    cfg: Qwen3Config
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        rope_tables: tuple[jax.Array, jax.Array],
+        *,
+        cache: Cache | None = None,
+        positions: jax.Array | None = None,
+    ) -> tuple[jax.Array, Cache | None]:
+        cfg = self.cfg
+        a, cache = Qwen3Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, name="ln1")(x), rope_tables,
+            cache=cache, positions=positions,
+        )
+        x = x + a
+        x = x + Qwen3MLP(cfg, name="mlp")(RMSNorm(cfg.rms_norm_eps, name="ln2")(x))
+        return x, cache
+
+
+class Qwen3(nn.Module):
+    """Qwen3 causal LM. ``model(idx) -> logits``; optional KV cache pytree."""
+
+    cfg: Qwen3Config
+
+    @nn.compact
+    def __call__(
+        self,
+        idx: jax.Array,
+        *,
+        deterministic: bool = True,  # accepted for train-step compatibility
+        caches: list[Cache] | None = None,
+        positions: jax.Array | None = None,
+    ):
+        cfg = self.cfg
+        compute_dtype = jnp.dtype(cfg.compute_dtype)
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size,
+            embedding_init=nn.initializers.normal(0.02), name="tok_embed",
+        )
+        x = embed(idx).astype(compute_dtype)
+        # One table pair per forward; constant-folded under jit.
+        rope_tables = rope_ops.precompute_cos_sin(
+            cfg.head_dim, cfg.max_seq_len, cfg.rope_theta
+        )
+        new_caches: list[Cache] | None = [] if caches is not None else None
+        for i in range(cfg.n_layer):
+            layer_cache = caches[i] if caches is not None else None
+            x, layer_cache = Qwen3Block(cfg, name=f"block_{i}")(
+                x, rope_tables, cache=layer_cache, positions=positions
+            )
+            if new_caches is not None:
+                new_caches.append(layer_cache)
+        x = RMSNorm(cfg.rms_norm_eps, name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, name="lm_head"
+            )(x.astype(jnp.float32))
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    # -- convenience API mirroring the in-tree GPT family ---------------------
+    def init_params(self, rng, example_len: int = 8):
+        return self.init(rng, jnp.ones((1, example_len), jnp.int32))["params"]
+
+    def make_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        return init_cache(self.cfg, batch, max_len, dtype)

@@ -31,8 +31,14 @@ def apply_rotary_emb(
     sin: jax.Array,
     *,
     positions: jax.Array | None = None,
+    interleaved: bool = True,
 ) -> jax.Array:
-    """Rotate interleaved even/odd feature pairs of x: (B, L, H, D).
+    """Rotate feature pairs of x: (B, L, H, D).
+
+    ``interleaved=True`` pairs even/odd lanes (the reference's formulation);
+    ``interleaved=False`` pairs lane ``i`` with ``i + D/2`` — the HF
+    "rotate_half" layout used by Qwen/Llama checkpoints. Same rotation,
+    different lane permutation; the cos/sin tables are shared.
 
     ``positions``: optional (B, L) absolute positions (for KV-cached decode);
     defaults to ``arange(L)``.
@@ -44,11 +50,18 @@ def apply_rotary_emb(
     else:
         cos_l = cos[positions][:, :, None, :]  # (B, L, 1, D/2)
         sin_l = sin[positions][:, :, None, :]
-    x_pairs = x.astype(jnp.float32).reshape(b, l, x.shape[2], d // 2, 2)
-    x_even, x_odd = x_pairs[..., 0], x_pairs[..., 1]
-    rot_even = x_even * cos_l - x_odd * sin_l
-    rot_odd = x_even * sin_l + x_odd * cos_l
-    out = jnp.stack([rot_even, rot_odd], axis=-1).reshape(x.shape)
+    xf = x.astype(jnp.float32)
+    if interleaved:
+        x_pairs = xf.reshape(b, l, x.shape[2], d // 2, 2)
+        x_even, x_odd = x_pairs[..., 0], x_pairs[..., 1]
+        rot_even = x_even * cos_l - x_odd * sin_l
+        rot_odd = x_even * sin_l + x_odd * cos_l
+        out = jnp.stack([rot_even, rot_odd], axis=-1).reshape(x.shape)
+    else:
+        x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+        out = jnp.concatenate(
+            [x1 * cos_l - x2 * sin_l, x2 * cos_l + x1 * sin_l], axis=-1
+        )
     return out.astype(x.dtype)
 
 

@@ -76,16 +76,7 @@ DEFAULT_RULES: tuple[tuple[str, PartitionSpec], ...] = (
 )
 
 
-def _path_str(path) -> str:
-    parts = []
-    for k in path:
-        if hasattr(k, "key"):
-            parts.append(str(k.key))
-        elif hasattr(k, "idx"):
-            parts.append(str(k.idx))
-        else:
-            parts.append(str(k))
-    return "/".join(parts)
+from llm_in_practise_tpu.utils.tree import path_str as _path_str  # shared contract
 
 
 def _fit_spec(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:

@@ -45,7 +45,7 @@ def qlora_apply(qparams, lora_params, cfg: lora_lib.LoRAConfig,
     return lora_lib.apply_lora(base, lora_params, cfg)
 
 
-def make_qlora_loss_fn(model, qparams, cfg: lora_lib.LoRAConfig,
+def make_qlora_loss_fn(qparams, cfg: lora_lib.LoRAConfig,
                        base_loss_fn, dtype=jnp.bfloat16):
     """Wrap a ``loss_fn(params, batch, rng)`` into one over LoRA params only."""
     def loss_fn(lora_params, batch, rng):

@@ -81,9 +81,10 @@ def quantize(w: jax.Array | np.ndarray) -> NF4Tensor:
     blocks = flat.reshape(-1, BLOCK)
     absmax = jnp.max(jnp.abs(blocks), axis=1)                      # (nb,)
     scaled = blocks / jnp.maximum(absmax, 1e-12)[:, None]
-    codes = jnp.argmin(
-        jnp.abs(scaled[..., None] - NF4_CODE), axis=-1
-    ).astype(jnp.uint8)                                            # (nb, BLOCK)
+    # Nearest codebook entry via searchsorted on the 15 midpoints — avoids
+    # the (nb, BLOCK, 16) broadcast a naive argmin would allocate.
+    midpoints = (NF4_CODE[1:] + NF4_CODE[:-1]) / 2.0               # (15,)
+    codes = jnp.searchsorted(midpoints, scaled).astype(jnp.uint8)  # (nb, BLOCK)
     codes = codes.reshape(-1)
     packed = (codes[0::2] << 4) | codes[1::2]                      # (n_pad//2,)
 

@@ -10,11 +10,19 @@ from __future__ import annotations
 import jax
 
 
+def _key_name(p) -> str:
+    if hasattr(p, "key"):  # DictKey
+        return str(p.key)
+    if hasattr(p, "name"):  # GetAttrKey
+        return str(p.name)
+    if hasattr(p, "idx"):  # SequenceKey
+        return str(p.idx)
+    return str(p)
+
+
 def path_str(path) -> str:
     """'a/b/c' form of a jax key path (DictKey/GetAttrKey/SequenceKey)."""
-    return "/".join(
-        p.key if hasattr(p, "key") else str(getattr(p, "idx", p)) for p in path
-    )
+    return "/".join(_key_name(p) for p in path)
 
 
 def flatten_with_paths(tree) -> dict:
