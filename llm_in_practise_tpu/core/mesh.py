@@ -86,8 +86,14 @@ def single_device_mesh() -> Mesh:
     return build_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for a per-step batch: leading dim split over data×fsdp."""
+def batch_sharding(mesh: Mesh, seq_sharded: bool = False) -> NamedSharding:
+    """Sharding for a per-step batch: leading dim split over data×fsdp.
+
+    ``seq_sharded`` additionally splits the second (sequence) dim over the
+    ``seq`` axis — the input layout for sequence-parallel training.
+    """
+    if seq_sharded:
+        return NamedSharding(mesh, PartitionSpec(BATCH_AXES, AXIS_SEQ))
     return NamedSharding(mesh, PartitionSpec(BATCH_AXES))
 
 

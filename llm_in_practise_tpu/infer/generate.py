@@ -19,6 +19,19 @@ import jax.numpy as jnp
 from llm_in_practise_tpu.infer.sampling import sample_token
 
 
+def max_positions(config) -> int | None:
+    """Longest position the model's RoPE / position tables cover.
+
+    Beyond this, position gathers clamp silently under jit and corrupt
+    logits — callers must never let a KV cache grow past it.
+    """
+    for field in ("max_seq_len", "seq_len"):
+        v = getattr(config, field, None)
+        if v is not None:
+            return int(v)
+    return None
+
+
 def make_decode_fns(model) -> tuple[Callable, Callable]:
     """Returns (prefill, decode_step), both jitted.
 
@@ -65,10 +78,11 @@ def generate(
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     b, prompt_len = prompt_ids.shape
-    cfg = model.config
-    # position tables (learned/sinusoidal/rope cos-sin) only cover seq_len
-    # rows; beyond that jit silently clamps the gather, so cap the cache.
-    cache_len = min(cache_len or cfg.seq_len, cfg.seq_len)
+    # position tables (learned/sinusoidal/rope cos-sin) only cover
+    # seq_len/max_seq_len rows; beyond that jit silently clamps the gather,
+    # so cap the cache.
+    limit = max_positions(model.config)
+    cache_len = min(cache_len or limit, limit)
     if prompt_len >= cache_len:
         prompt_ids = prompt_ids[:, -(cache_len - 1):]
         prompt_len = prompt_ids.shape[1]

@@ -30,7 +30,7 @@ def sample_token(
     if top_k is not None and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, NEG_INF, logits)
-    if top_p is not None and 0.0 < top_p < 1.0:
+    if top_p is not None and top_p < 1.0:  # 0.0 = keep only the top token
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
@@ -41,3 +41,50 @@ def sample_token(
         )
         logits = jnp.where(logits < cutoff_logit, NEG_INF, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def sample_token_batched(
+    rng: jax.Array,
+    logits: jax.Array,
+    *,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    greedy: jax.Array,
+) -> jax.Array:
+    """Per-row sampling params — the continuous-batching sampler.
+
+    Every slot in the serving engine carries its own request's sampling
+    settings, so all params are ``(B,)`` vectors: ``temperature`` floats,
+    ``top_k`` ints (0 disables), ``top_p`` floats (>=1.0 disables),
+    ``greedy`` bools. logits: ``(B, vocab)``. Jittable, static shapes.
+    """
+    n_vocab = logits.shape[-1]
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # One O(V log V) sort serves both filters (the top-k masking below keeps
+    # descending order, so no re-sort for top-p).
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+
+    # Row-wise top-k: kth-largest threshold per row (k=0 -> keep all).
+    k_idx = jnp.clip(top_k - 1, 0, n_vocab - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    k_on = top_k[:, None] > 0
+    scaled = jnp.where(k_on & (scaled < kth), NEG_INF, scaled)
+    sorted_desc = jnp.where(
+        k_on & (jnp.arange(n_vocab)[None, :] > k_idx[:, None]), NEG_INF, sorted_desc
+    )
+
+    # Row-wise top-p over the filtered logits; top_p=0 is most restrictive
+    # (keeps exactly the top-1), >=1 disables.
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_mask = cum - probs > top_p[:, None]
+    cutoff_logit = jnp.min(
+        jnp.where(cutoff_mask, jnp.inf, sorted_desc), axis=-1, keepdims=True
+    )
+    use_p = (top_p < 1.0)[:, None]
+    scaled = jnp.where(use_p & (scaled < cutoff_logit), NEG_INF, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled)

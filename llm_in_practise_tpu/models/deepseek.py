@@ -141,7 +141,7 @@ class MLA(nn.Module):
 
         if positions is None:
             start = cache["index"] if cache is not None else 0
-            positions = jnp.broadcast_to(start + jnp.arange(l)[None, :], (b, l))
+            positions = layers.cache_positions(start, b, l)
 
         k_up = dense(h * hd, "k_up")
         v_up = dense(h * hd, "v_up")
@@ -167,9 +167,8 @@ class MLA(nn.Module):
             # Cache the compressed latent; decompress the whole valid prefix
             # each step (batched matmul — MXU work, not HBM). RoPE phases are
             # reconstructed from absolute positions.
-            lat_cache = jax.lax.dynamic_update_slice(
-                cache["kv"], kv_latent.astype(cache["kv"].dtype),
-                (0, cache["index"], 0),
+            lat_cache = layers.cache_update(
+                cache["kv"], kv_latent, cache["index"]
             )
             q_offset = cache["index"]
             cache = {"kv": lat_cache, "index": cache["index"] + l}
@@ -184,12 +183,8 @@ class MLA(nn.Module):
             v = v_up(kv_latent).reshape(b, l, h, hd)
             k = rope_ops.apply_rotary_emb(k, cos, sin, positions=positions)
             q_offset = cache["index"]
-            k_cache = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, cache["index"], 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, cache["index"], 0, 0)
-            )
+            k_cache = layers.cache_update(cache["k"], k, cache["index"])
+            v_cache = layers.cache_update(cache["v"], v, cache["index"])
             cache = {"k": k_cache, "v": v_cache, "index": cache["index"] + l}
             k, v = k_cache.astype(q.dtype), v_cache.astype(q.dtype)
 

@@ -80,7 +80,7 @@ class GPT(nn.Module):
 
         if positions is None:
             start = cache[0]["index"] if cache is not None else 0
-            positions = jnp.broadcast_to(start + jnp.arange(l)[None, :], (b, l))
+            positions = layers.cache_positions(start, b, l)
         if cfg.pos_embedding == "learned":
             pos_table = self.param(
                 "pos_embed", layers.dense_init, (cfg.seq_len, cfg.embed_dim)

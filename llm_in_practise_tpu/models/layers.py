@@ -32,6 +32,37 @@ def _activation(name: str):
     return {"gelu": nn.gelu, "relu": nn.relu, "silu": nn.silu}[name]
 
 
+def cache_positions(index: jax.Array, batch: int, length: int) -> jax.Array:
+    """(B, L) absolute positions for the current query block.
+
+    ``index`` is the cache write index — a scalar (all sequences in lockstep,
+    plain generate) or a ``(B,)`` vector (continuous batching: every slot at
+    its own depth).
+    """
+    index = jnp.asarray(index)
+    if index.ndim == 1:
+        return index[:, None] + jnp.arange(length)[None, :]
+    pos = index + jnp.arange(length)[None, :]
+    return jnp.broadcast_to(pos, (batch, length))
+
+
+def cache_update(buf: jax.Array, new: jax.Array, index: jax.Array) -> jax.Array:
+    """Write ``new`` (B, L, ...) into ``buf`` (B, max_len, ...) at ``index``.
+
+    Scalar index → one dynamic_update_slice; ``(B,)`` vector index → per-slot
+    scatter (vmapped), the continuous-batching write path. Works for 4D KV
+    buffers and the 3D MLA latent cache alike.
+    """
+    new = new.astype(buf.dtype)
+    index = jnp.asarray(index)
+    trailing = (0,) * (buf.ndim - 2)
+    if index.ndim == 1:
+        return jax.vmap(
+            lambda b, n, i: jax.lax.dynamic_update_slice(b, n, (i, *trailing))
+        )(buf, new, index)
+    return jax.lax.dynamic_update_slice(buf, new, (0, index, *trailing))
+
+
 def init_cache(
     batch: int, max_len: int, n_kv_head: int, head_dim: int, n_layer: int,
     dtype=jnp.bfloat16,
@@ -81,20 +112,15 @@ class CausalSelfAttention(nn.Module):
                 head_dim, self.max_seq_len, self.rope_theta
             )
             if positions is None and cache is not None:
-                positions = cache["index"] + jnp.arange(l)[None, :]
-                positions = jnp.broadcast_to(positions, (b, l))
+                positions = cache_positions(cache["index"], b, l)
             q = rope_ops.apply_rotary_emb(q, cos, sin, positions=positions)
             k = rope_ops.apply_rotary_emb(k, cos, sin, positions=positions)
 
         q_offset = None
         if cache is not None:
             q_offset = cache["index"]  # absolute position of first query
-            k_cache = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, cache["index"], 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, cache["index"], 0, 0)
-            )
+            k_cache = cache_update(cache["k"], k, cache["index"])
+            v_cache = cache_update(cache["v"], v, cache["index"])
             cache = {"k": k_cache, "v": v_cache, "index": cache["index"] + l}
             k, v = k_cache.astype(q.dtype), v_cache.astype(q.dtype)
 

@@ -25,6 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from llm_in_practise_tpu.models import layers
 from llm_in_practise_tpu.ops import rope as rope_ops
 from llm_in_practise_tpu.ops.attention import dot_product_attention
 
@@ -147,8 +148,7 @@ class Qwen3Attention(nn.Module):
 
         cos, sin = rope_tables
         if positions is None and cache is not None:
-            positions = cache["index"] + jnp.arange(l)[None, :]
-            positions = jnp.broadcast_to(positions, (b, l))
+            positions = layers.cache_positions(cache["index"], b, l)
         # HF rotate_half lane layout — required for checkpoint fidelity.
         q = rope_ops.apply_rotary_emb(q, cos, sin, positions=positions, interleaved=False)
         k = rope_ops.apply_rotary_emb(k, cos, sin, positions=positions, interleaved=False)
@@ -156,12 +156,8 @@ class Qwen3Attention(nn.Module):
         q_offset = None
         if cache is not None:
             q_offset = cache["index"]
-            k_cache = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, cache["index"], 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, cache["index"], 0, 0)
-            )
+            k_cache = layers.cache_update(cache["k"], k, cache["index"])
+            v_cache = layers.cache_update(cache["v"], v, cache["index"])
             cache = {"k": k_cache, "v": v_cache, "index": cache["index"] + l}
             k, v = k_cache.astype(q.dtype), v_cache.astype(q.dtype)
 
@@ -229,7 +225,7 @@ class Qwen3(nn.Module):
         idx: jax.Array,
         *,
         deterministic: bool = True,  # accepted for train-step compatibility
-        caches: list[Cache] | None = None,
+        cache: list[Cache] | None = None,
         positions: jax.Array | None = None,
     ):
         cfg = self.cfg
@@ -243,9 +239,9 @@ class Qwen3(nn.Module):
         rope_tables = rope_ops.precompute_cos_sin(
             cfg.head_dim, cfg.max_seq_len, cfg.rope_theta
         )
-        new_caches: list[Cache] | None = [] if caches is not None else None
+        new_caches: list[Cache] | None = [] if cache is not None else None
         for i in range(cfg.n_layer):
-            layer_cache = caches[i] if caches is not None else None
+            layer_cache = cache[i] if cache is not None else None
             x, layer_cache = Qwen3Block(cfg, name=f"block_{i}")(
                 x, rope_tables, cache=layer_cache, positions=positions
             )
@@ -258,13 +254,17 @@ class Qwen3(nn.Module):
             logits = nn.Dense(
                 cfg.vocab_size, use_bias=False, name="lm_head"
             )(x.astype(jnp.float32))
-        if caches is not None:
+        if cache is not None:
             return logits, new_caches
         return logits
 
-    # -- convenience API mirroring the in-tree GPT family ---------------------
+    # -- convenience API shared by every in-tree model family -----------------
+    @property
+    def config(self) -> Qwen3Config:
+        return self.cfg
+
     def init_params(self, rng, example_len: int = 8):
         return self.init(rng, jnp.ones((1, example_len), jnp.int32))["params"]
 
-    def make_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
         return init_cache(self.cfg, batch, max_len, dtype)

@@ -27,15 +27,22 @@ NEG_INF = -1e30
 def causal_mask(
     q_len: int, kv_len: int, dtype=jnp.float32, q_offset: jax.Array | int | None = None
 ) -> jax.Array:
-    """Additive causal mask of shape (1, 1, q_len, kv_len).
+    """Additive causal mask of shape (1|B, 1, q_len, kv_len).
 
     ``q_offset`` is the absolute position of the first query. Default places
     the query block at the end of the kv sequence (plain decode); a KV-cached
     prefill passes the cache write index so queries mid-buffer mask both
-    future prompt positions and unwritten cache slots.
+    future prompt positions and unwritten cache slots. A ``(B,)`` vector
+    offset gives per-sequence positions (continuous-batching decode, where
+    every slot is at a different depth in its cache).
     """
     if q_offset is None:
         q_offset = kv_len - q_len
+    q_offset = jnp.asarray(q_offset)
+    if q_offset.ndim == 1:  # per-batch offsets -> (B, q_len) query positions
+        q_pos = jnp.arange(q_len)[None, :] + q_offset[:, None]
+        allowed = jnp.arange(kv_len)[None, None, :] <= q_pos[:, :, None]
+        return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)[:, None]
     q_pos = jnp.arange(q_len)[:, None] + q_offset
     kv_pos = jnp.arange(kv_len)[None, :]
     allowed = kv_pos <= q_pos
@@ -99,6 +106,14 @@ def dot_product_attention(
     """Attention entry point used by every model in the framework."""
     if impl == "auto":
         impl = _pick_impl(q, k, bias, kv_length, dropout_rate, causal)
+    if impl == "ring":
+        from llm_in_practise_tpu.ops import ring_attention as ra
+
+        if (bias is None and kv_length is None and dropout_rate == 0.0
+                and q_offset is None and k.shape[1] == q.shape[1]
+                and ra.active_sp_mesh() is not None):
+            return ra.context_ring_attention(q, k, v, causal=causal, scale=scale)
+        impl = "dense"  # decode/cached paths fall back (KV not seq-sharded)
     if impl == "flash":
         from llm_in_practise_tpu.ops import flash_attention as fa
 

@@ -270,6 +270,19 @@ def expert_parallel(expert: int, fsdp_size: int = 1, data: int = -1) -> Strategy
     )
 
 
+def sequence_parallel(seq: int, fsdp_size: int = 1, data: int = -1) -> Strategy:
+    """Sequence/context parallelism over the ``seq`` axis via ring attention —
+    beyond the reference (absent there, SURVEY §5.7). Activations are sharded
+    ``(batch over data×fsdp, sequence over seq)``; models must set
+    ``attn_impl='ring'`` and run under
+    :class:`llm_in_practise_tpu.ops.ring_attention.sp_context`."""
+    return Strategy(
+        "sp",
+        mesh_lib.MeshSpec(data=data, fsdp=fsdp_size, seq=seq),
+        zero_stage=3 if fsdp_size > 1 else 0,
+    )
+
+
 STRATEGIES = {
     "ddp": ddp,
     "zero1": zero1,
@@ -279,6 +292,7 @@ STRATEGIES = {
     "tp": tensor_parallel,
     "fsdp_tp": fsdp_tp,
     "ep": expert_parallel,
+    "sp": sequence_parallel,
 }
 
 

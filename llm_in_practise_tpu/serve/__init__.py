@@ -1,0 +1,17 @@
+"""Serving: continuous-batching engine + OpenAI-compatible HTTP API.
+
+The TPU-native replacement for the reference's serving ladder — hand-rolled
+FastAPI server (``Scripts/inference/07-deepseek1.5b-api-infr.py``), vLLM, and
+Ray Serve LLM apps (``Deployment/``): one in-tree engine
+(:class:`~llm_in_practise_tpu.serve.engine.InferenceEngine`) with slot-based
+continuous batching over a static-shape KV cache, and a dependency-free HTTP
+layer (:class:`~llm_in_practise_tpu.serve.api.OpenAIServer`) with streaming
+and Prometheus metrics.
+"""
+
+from llm_in_practise_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine,
+    Request,
+    SamplingParams,
+)
+from llm_in_practise_tpu.serve.api import OpenAIServer, build_prompt  # noqa: F401

@@ -1,0 +1,223 @@
+"""OpenAI-compatible HTTP server over the continuous-batching engine.
+
+Parity with the reference's FastAPI server
+(``Scripts/inference/07-deepseek1.5b-api-infr.py``):
+
+- ``POST /v1/chat/completions`` — non-streaming (``:105-161``) **and** SSE
+  streaming, which the reference stubs out with a 501 (``:110-112``); here it
+  is implemented (chunked ``data:`` events + ``[DONE]``), closing that gap
+  the reference defers to vLLM.
+- prompt build from OpenAI messages (``:37-57``) — ChatML via
+  :func:`llm_in_practise_tpu.data.sft.render_chatml` plus the generation
+  prompt suffix.
+- usage accounting (``:118-152``), ``GET /v1/models``, ``GET /health``.
+- ``GET /metrics`` — Prometheus text exposition with the platform's canonical
+  serving metrics (queue depth, running requests, TTFT/TPOT quantiles —
+  mirroring the PromQL table ``LLM_on_Kubernetes/Inference_Platfrom/
+  README.md:1676-1692``).
+
+Built on the stdlib ``ThreadingHTTPServer`` — the serving runtime carries no
+web-framework dependency; each connection gets an OS thread, generation
+throughput is owned by the engine's single background loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from llm_in_practise_tpu.data.sft import IM_START, render_chatml
+from llm_in_practise_tpu.serve import schemas
+from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+
+
+def build_prompt(messages) -> str:
+    """OpenAI messages -> ChatML generation prompt (reference ``:37-57``)."""
+    rendered = render_chatml([{"role": m.role, "content": m.content} for m in messages])
+    return rendered + f"\n{IM_START}assistant\n"
+
+
+def _quantile(values, q):
+    if not values:
+        return 0.0
+    return float(np.quantile(np.asarray(values), q))
+
+
+class OpenAIServer:
+    """Wires engine + tokenizer + HTTP. ``tokenizer`` needs ``encode``/``decode``."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        tokenizer,
+        *,
+        model_name: str = "llm-in-practise-tpu",
+        prompt_builder=build_prompt,
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.prompt_builder = prompt_builder
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # --- request handling ----------------------------------------------------
+
+    def handle_chat(self, body: dict, send_json, send_stream):
+        try:
+            req = schemas.ChatCompletionRequest.from_dict(body)
+        except schemas.ValidationError as e:
+            return send_json(422, {"error": {"message": str(e), "type": "invalid_request_error"}})
+
+        prompt = self.prompt_builder(req.messages)
+        prompt_ids = self.tokenizer.encode(prompt)
+        params = SamplingParams(
+            temperature=req.temperature,
+            top_k=req.top_k,
+            top_p=req.top_p,
+            greedy=req.temperature == 0.0,
+            max_tokens=req.max_tokens,
+        )
+        handle = self.engine.submit(prompt_ids, params)
+        req_id = schemas.completion_id()
+
+        if req.stream:
+            def chunks():
+                yield schemas.chat_completion_chunk(
+                    req_id=req_id, model=req.model, delta=None
+                )
+                tokens, prev_text = [], ""
+                for tok in handle:
+                    tokens.append(tok)
+                    text = self.tokenizer.decode(tokens)
+                    delta, prev_text = text[len(prev_text):], text
+                    if delta:
+                        yield schemas.chat_completion_chunk(
+                            req_id=req_id, model=req.model, delta=delta
+                        )
+                yield schemas.chat_completion_chunk(
+                    req_id=req_id, model=req.model, delta=None,
+                    finish_reason=handle.finish_reason or "stop",
+                )
+            return send_stream(chunks())
+
+        out_ids = handle.result()
+        text = self.tokenizer.decode(out_ids)
+        usage = schemas.Usage(len(prompt_ids), len(out_ids))
+        return send_json(200, schemas.chat_completion_response(
+            req_id=req_id, model=req.model, text=text,
+            finish_reason=handle.finish_reason or "stop", usage=usage,
+        ))
+
+    def metrics_text(self) -> str:
+        s = self.engine.stats
+        with s.lock:
+            ttft, tpot = list(s.ttft_s), list(s.tpot_s)
+            lines = [
+                "# TYPE llm_requests_total counter",
+                f"llm_requests_total {s.requests_total}",
+                "# TYPE llm_tokens_generated_total counter",
+                f"llm_tokens_generated_total {s.tokens_generated_total}",
+                "# TYPE llm_num_requests_waiting gauge",
+                f"llm_num_requests_waiting {s.queue_depth}",
+                "# TYPE llm_num_requests_running gauge",
+                f"llm_num_requests_running {s.active_slots}",
+            ]
+        for name, vals in (("llm_ttft_seconds", ttft), ("llm_tpot_seconds", tpot)):
+            lines += [
+                f"# TYPE {name} summary",
+                f'{name}{{quantile="0.5"}} {_quantile(vals, 0.5):.6f}',
+                f'{name}{{quantile="0.99"}} {_quantile(vals, 0.99):.6f}',
+                f"{name}_count {len(vals)}",
+                f"{name}_sum {sum(vals):.6f}",
+            ]
+        return "\n".join(lines) + "\n"
+
+    # --- HTTP plumbing -------------------------------------------------------
+
+    def make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet; obs handles logging
+                pass
+
+            def _json(self, status: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _sse(self, events):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for event in events:
+                        payload = f"data: {json.dumps(event)}\n\n".encode()
+                        self.wfile.write(payload)
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-stream
+
+            def do_GET(self):
+                if self.path == "/health":
+                    return self._json(200, {"status": "ok"})
+                if self.path == "/v1/models":
+                    return self._json(200, {
+                        "object": "list",
+                        "data": [{
+                            "id": server.model_name,
+                            "object": "model",
+                            "owned_by": "llm-in-practise-tpu",
+                        }],
+                    })
+                if self.path == "/metrics":
+                    body = server.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                return self._json(404, {"error": {"message": "not found"}})
+
+            def do_POST(self):
+                if self.path not in ("/v1/chat/completions",):
+                    return self._json(404, {"error": {"message": "not found"}})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._json(400, {"error": {"message": "invalid JSON body"}})
+                return server.handle_chat(body, self._json, self._sse)
+
+        return Handler
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8000, *, background: bool = False):
+        """Start engine loop + HTTP server. Returns the bound port."""
+        if self.engine._thread is None:
+            self.engine.start()
+        self._httpd = ThreadingHTTPServer((host, port), self.make_handler())
+        bound = self._httpd.server_address[1]
+        if background:
+            threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        else:
+            self._httpd.serve_forever()
+        return bound
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.engine.stop()

@@ -1,0 +1,374 @@
+"""Continuous-batching inference engine — the TPU answer to vLLM's core loop.
+
+The reference serves models three ways: raw HF ``generate`` behind FastAPI
+(``Scripts/inference/07-deepseek1.5b-api-infr.py:122-130``, one request at a
+time), vLLM (continuous batching + paged KV, CUDA), and Ray Serve replicas of
+vLLM. This engine is the from-scratch TPU equivalent of the vLLM loop:
+
+- **Slot-based static KV cache**: a ``(max_slots, cache_len, …)`` buffer per
+  layer. Requests are admitted into free slots mid-flight; every jitted step
+  decodes ALL slots in one batched forward — no retrace, no dynamic shapes.
+  (vLLM pages the cache; here the slot dimension is the batching unit and
+  XLA keeps the buffer resident in HBM. Paged/prefix reuse is layered on in
+  :mod:`llm_in_practise_tpu.serve.prefix_cache`.)
+- **Per-slot positions**: each cache entry carries a ``(max_slots,)`` index
+  vector; writes scatter per slot (``models.layers.cache_update``) and the
+  causal mask uses per-slot offsets, so slot 0 can be 900 tokens deep while
+  slot 1 is prefilling.
+- **Per-slot sampling params** via
+  :func:`llm_in_practise_tpu.infer.sampling.sample_token_batched`.
+- **Bucketed prefill**: prompts are right-padded to a few bucket lengths so
+  prefill compiles once per bucket, then cache rows are scattered into the
+  slot (chunked-prefill analog — vLLM ``enable_chunked_prefill``,
+  ``Deployment/Ray/serve_run_examples/deepseek.py:33``).
+
+Threading: HTTP handler threads call :meth:`InferenceEngine.submit`; one
+background thread runs :meth:`step` forever. Tokens stream to per-request
+queues — the producer/consumer shape of the reference's
+``TextIteratorStreamer`` + generation thread
+(``Scripts/inference/06-…-streaming-infr.py:52-75``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_in_practise_tpu.infer.generate import max_positions
+from llm_in_practise_tpu.infer.sampling import sample_token_batched
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (OpenAI request fields)."""
+
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0      # >= 1.0 = disabled
+    greedy: bool = False
+    max_tokens: int = 128
+
+
+_FINISH = object()  # sentinel closing a request's token queue
+
+
+@dataclasses.dataclass
+class Request:
+    """A submitted generation request and its streaming output channel."""
+
+    uid: int
+    prompt_ids: list[int]
+    params: SamplingParams
+    tokens: "queue.Queue[Any]" = dataclasses.field(default_factory=queue.Queue)
+    submit_time: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_time: float | None = None
+    finish_time: float | None = None
+    finish_reason: str | None = None
+    n_generated: int = 0
+
+    def __iter__(self):
+        """Yield generated token ids until the request finishes."""
+        while True:
+            item = self.tokens.get()
+            if item is _FINISH:
+                return
+            yield item
+
+    def result(self) -> list[int]:
+        return list(self)
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+    @property
+    def tpot_s(self) -> float | None:
+        """Mean time per output token after the first."""
+        if self.finish_time is None or self.n_generated < 2:
+            return None
+        return (self.finish_time - self.first_token_time) / (self.n_generated - 1)
+
+
+class EngineStats:
+    """Counters/histograms surfaced at /metrics (SURVEY §5.5 PromQL table)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.requests_total = 0
+        self.tokens_generated_total = 0
+        self.ttft_s: list[float] = []
+        self.tpot_s: list[float] = []
+        self.queue_depth = 0
+        self.active_slots = 0
+
+    def observe_finished(self, req: Request):
+        with self.lock:
+            self.tokens_generated_total += req.n_generated
+            if req.ttft_s is not None:
+                self.ttft_s.append(req.ttft_s)
+            if req.tpot_s is not None:
+                self.tpot_s.append(req.tpot_s)
+
+
+def _default_buckets(cache_len: int) -> tuple[int, ...]:
+    out, b = [], 16
+    while b < cache_len:
+        out.append(b)
+        b *= 2
+    return tuple(out) or (cache_len,)
+
+
+class InferenceEngine:
+    """Continuous-batching decode loop over a slot-structured KV cache.
+
+    ``model`` must expose ``init_cache(batch, max_len, dtype=...)`` and a
+    flax ``apply`` taking ``(idx, deterministic=..., cache=...)`` and
+    returning ``(logits, cache)`` — true of every model family in-tree.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        max_slots: int = 8,
+        cache_len: int = 512,
+        eos_id: int | None = None,
+        cache_dtype=jnp.bfloat16,
+        prefill_buckets: tuple[int, ...] | None = None,
+        rng: jax.Array | None = None,
+    ):
+        self.model = model
+        self.params = params
+        self.max_slots = max_slots
+        limit = max_positions(getattr(model, "config", None))
+        self.cache_len = min(cache_len, limit) if limit else cache_len
+        self.eos_id = eos_id
+        self.cache_dtype = cache_dtype
+        self.buckets = tuple(
+            b for b in (prefill_buckets or _default_buckets(self.cache_len))
+            if b <= self.cache_len
+        )
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        self.cache = model.init_cache(max_slots, self.cache_len, dtype=cache_dtype)
+        self._vectorize_cache_index()
+
+        # Host-side slot table (slot_len mirrors the device cache index so
+        # finish checks never force a device sync).
+        self.slot_req: list[Request | None] = [None] * max_slots
+        self.slot_last_token = np.zeros((max_slots,), np.int32)
+        self.slot_len = np.zeros((max_slots,), np.int64)
+        self.slot_budget = np.zeros((max_slots,), np.int64)  # tokens remaining
+        self._temperature = np.ones((max_slots,), np.float32)
+        self._top_k = np.zeros((max_slots,), np.int32)
+        self._top_p = np.ones((max_slots,), np.float32)
+        self._greedy = np.zeros((max_slots,), bool)
+
+        self.pending: "queue.Queue[Request]" = queue.Queue()
+        self.stats = EngineStats()
+        self._uid = itertools.count()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()  # set on submit; idle loop waits on it
+        self._thread: threading.Thread | None = None
+
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+        self._prefill = jax.jit(self._prefill_fn)
+        self._insert = jax.jit(self._insert_fn, donate_argnums=(0,),
+                               static_argnames=("slot",))
+
+    # --- jitted pieces -------------------------------------------------------
+
+    def _vectorize_cache_index(self):
+        """Scalar per-layer cache index -> (max_slots,) vector."""
+        for layer in self.cache:
+            layer["index"] = jnp.zeros((self.max_slots,), jnp.int32)
+
+    def _decode_fn(self, params, cache, tokens, rng, temperature, top_k, top_p, greedy):
+        logits, cache = self.model.apply(
+            {"params": params}, tokens[:, None], deterministic=True, cache=cache
+        )
+        next_tok = sample_token_batched(
+            rng, logits[:, -1, :].astype(jnp.float32),
+            temperature=temperature, top_k=top_k, top_p=top_p, greedy=greedy,
+        )
+        return next_tok.astype(jnp.int32), cache
+
+    def _prefill_fn(self, params, prompt_ids, length):
+        """prompt_ids: (1, bucket). Returns (last-valid logits, cache rows)."""
+        cache = self.model.init_cache(1, self.cache_len, dtype=self.cache_dtype)
+        logits, cache = self.model.apply(
+            {"params": params}, prompt_ids, deterministic=True, cache=cache
+        )
+        last = jnp.take_along_axis(
+            logits, (length - 1)[None, None, None], axis=1
+        )[:, 0, :]
+        return last, cache
+
+    def _insert_fn(self, engine_cache, prefill_cache, slot: int, length):
+        """Copy a prefilled request's cache rows into ``slot``."""
+        new = []
+        for eng, pre in zip(engine_cache, prefill_cache):
+            layer = {}
+            for key in eng:
+                if key == "index":
+                    layer["index"] = eng["index"].at[slot].set(length)
+                else:
+                    layer[key] = eng[key].at[slot].set(pre[key][0])
+            new.append(layer)
+        return new
+
+    # --- public API ----------------------------------------------------------
+
+    def submit(self, prompt_ids, params: SamplingParams | None = None) -> Request:
+        params = params or SamplingParams()
+        prompt_ids = list(map(int, prompt_ids))
+        max_prompt = self.cache_len - 2
+        if len(prompt_ids) > max_prompt:  # sliding-window crop (reference
+            prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
+        req = Request(next(self._uid), prompt_ids, params)
+        self.pending.put(req)
+        with self.stats.lock:
+            self.stats.requests_total += 1
+            self.stats.queue_depth = self.pending.qsize()
+        self._wake.set()
+        return req
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.cache_len
+
+    def _admit(self) -> bool:
+        """Move pending requests into free slots (prefill + insert)."""
+        admitted = False
+        for slot in range(self.max_slots):
+            if self.slot_req[slot] is not None:
+                continue
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                break
+            plen = len(req.prompt_ids)
+            bucket = self._bucket_for(plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = req.prompt_ids
+            last_logits, pre_cache = self._prefill(
+                self.params, jnp.asarray(padded), jnp.asarray(plen, jnp.int32)
+            )
+            self.cache = self._insert(
+                self.cache, pre_cache, slot, jnp.asarray(plen, jnp.int32)
+            )
+            # First generated token comes from the prefill logits.
+            self.rng, sub = jax.random.split(self.rng)
+            first = sample_token_batched(
+                sub, last_logits.astype(jnp.float32),
+                temperature=jnp.asarray([req.params.temperature], jnp.float32),
+                top_k=jnp.asarray([req.params.top_k], jnp.int32),
+                top_p=jnp.asarray([req.params.top_p], jnp.float32),
+                greedy=jnp.asarray([req.params.greedy], bool),
+            )
+            first_id = int(first[0])
+            req.first_token_time = time.monotonic()
+
+            self.slot_req[slot] = req
+            self.slot_last_token[slot] = first_id
+            self.slot_len[slot] = plen
+            self.slot_budget[slot] = req.params.max_tokens - 1
+            self._temperature[slot] = req.params.temperature
+            self._top_k[slot] = req.params.top_k
+            self._top_p[slot] = req.params.top_p
+            self._greedy[slot] = req.params.greedy
+            admitted = True
+
+            self._emit(slot, first_id)
+        with self.stats.lock:
+            self.stats.queue_depth = self.pending.qsize()
+            self.stats.active_slots = sum(r is not None for r in self.slot_req)
+        return admitted
+
+    def _emit(self, slot: int, token_id: int):
+        req = self.slot_req[slot]
+        budget_left = self.slot_budget[slot] > 0
+        hit_eos = self.eos_id is not None and token_id == self.eos_id
+        # cache_len guard: the emitted token's write (next decode) must fit.
+        room = self.slot_len[slot] + 1 < self.cache_len
+        if not hit_eos:
+            req.tokens.put(token_id)
+            req.n_generated += 1
+        if hit_eos or not budget_left or not room:
+            req.finish_time = time.monotonic()
+            req.finish_reason = (
+                "stop" if hit_eos else ("length" if not budget_left else "cache")
+            )
+            req.tokens.put(_FINISH)
+            self.stats.observe_finished(req)
+            self.slot_req[slot] = None
+            self.slot_budget[slot] = 0
+
+    def step(self) -> bool:
+        """One engine iteration. Returns False when fully idle."""
+        with self._lock:
+            self._admit()
+            active = [s for s, r in enumerate(self.slot_req) if r is not None]
+            if not active:
+                return False
+            self.rng, sub = jax.random.split(self.rng)
+            next_tok, self.cache = self._decode(
+                self.params, self.cache,
+                jnp.asarray(self.slot_last_token),
+                sub,
+                jnp.asarray(self._temperature),
+                jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                jnp.asarray(self._greedy),
+            )
+            next_host = np.asarray(next_tok)
+            for slot in active:
+                self.slot_budget[slot] -= 1
+                self.slot_len[slot] += 1  # the decode wrote one token's KV
+                self.slot_last_token[slot] = next_host[slot]
+                self._emit(slot, int(next_host[slot]))
+            with self.stats.lock:
+                self.stats.active_slots = sum(r is not None for r in self.slot_req)
+            return True
+
+    # --- background loop -----------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            busy = self.step()
+            if not busy:  # idle: block until a submit wakes us (don't spin)
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # --- convenience ---------------------------------------------------------
+
+    def generate(self, prompt_ids, params: SamplingParams | None = None) -> list[int]:
+        """Blocking single-request helper (drives steps if no thread runs)."""
+        req = self.submit(prompt_ids, params)
+        if self._thread is None:
+            while self.step():
+                pass
+        return req.result()

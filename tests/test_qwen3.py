@@ -60,13 +60,13 @@ def test_forward_shape_and_cache_parity():
 
     # KV-cached prefill + decode must match the dense forward.
     caches = init_cache(cfg, 2, 32, dtype=jnp.float32)
-    logits_c, caches = model.apply({"params": params}, idx[:, :8], caches=caches)
+    logits_c, caches = model.apply({"params": params}, idx[:, :8], cache=caches)
     np.testing.assert_allclose(
         np.asarray(logits_c), np.asarray(logits[:, :8]), rtol=2e-3, atol=2e-3
     )
     step_logits = []
     for t in range(8, 16):
-        lg, caches = model.apply({"params": params}, idx[:, t : t + 1], caches=caches)
+        lg, caches = model.apply({"params": params}, idx[:, t : t + 1], cache=caches)
         step_logits.append(np.asarray(lg[:, 0]))
     dense_tail = np.asarray(logits[:, 8:])
     np.testing.assert_allclose(

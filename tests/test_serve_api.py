@@ -1,0 +1,128 @@
+"""HTTP-level tests of the OpenAI-compatible server (stdlib http.client
+against a live ThreadingHTTPServer on an ephemeral port)."""
+
+import http.client
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
+from llm_in_practise_tpu.serve.api import OpenAIServer
+from llm_in_practise_tpu.serve.engine import InferenceEngine
+
+
+class ByteTokenizer:
+    """Minimal tokenizer protocol for tests: one byte = one token."""
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8", errors="replace")[:200])
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
+
+
+@pytest.fixture(scope="module")
+def server(request):
+    import jax
+
+    cfg = GPTConfig(vocab_size=256, seq_len=256, n_layer=2, n_head=2,
+                    embed_dim=32, dropout=0.0, pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    engine = InferenceEngine(model, params, max_slots=2, cache_len=256,
+                             cache_dtype=jnp.float32)
+    srv = OpenAIServer(engine, ByteTokenizer(), model_name="tiny-test")
+    port = srv.serve(host="127.0.0.1", port=0, background=True)
+    yield ("127.0.0.1", port)
+    srv.shutdown()
+
+
+def _post(addr, path, payload):
+    conn = http.client.HTTPConnection(*addr, timeout=60)
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def _get(addr, path):
+    conn = http.client.HTTPConnection(*addr, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+def test_health_and_models(server):
+    status, body = _get(server, "/health")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, body = _get(server, "/v1/models")
+    data = json.loads(body)
+    assert status == 200 and data["data"][0]["id"] == "tiny-test"
+
+
+def test_chat_completion_roundtrip(server):
+    status, body = _post(server, "/v1/chat/completions", {
+        "model": "tiny-test",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 8,
+        "temperature": 0.0,
+    })
+    assert status == 200, body
+    data = json.loads(body)
+    assert data["object"] == "chat.completion"
+    choice = data["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] in ("stop", "length", "cache")
+    usage = data["usage"]
+    assert usage["prompt_tokens"] > 0
+    assert usage["total_tokens"] == usage["prompt_tokens"] + usage["completion_tokens"]
+    assert usage["completion_tokens"] <= 8
+
+
+def test_validation_errors(server):
+    status, body = _post(server, "/v1/chat/completions",
+                         {"model": "tiny-test", "messages": []})
+    assert status == 422
+    assert "messages" in json.loads(body)["error"]["message"]
+    status, _ = _post(server, "/v1/chat/completions", {
+        "model": "tiny-test",
+        "messages": [{"role": "alien", "content": "x"}],
+    })
+    assert status == 422
+
+
+def test_streaming_sse(server):
+    conn = http.client.HTTPConnection(*server, timeout=60)
+    conn.request("POST", "/v1/chat/completions", json.dumps({
+        "model": "tiny-test",
+        "messages": [{"role": "user", "content": "stream please"}],
+        "max_tokens": 6,
+        "temperature": 0.0,
+        "stream": True,
+    }), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    raw = resp.read().decode()
+    conn.close()
+    events = [line[6:] for line in raw.split("\n") if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    parsed = [json.loads(e) for e in events[:-1]]
+    assert parsed[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert parsed[-1]["choices"][0]["finish_reason"] in ("stop", "length", "cache")
+    text = "".join(p["choices"][0]["delta"].get("content", "") for p in parsed)
+    assert isinstance(text, str)
+
+
+def test_metrics_exposition(server):
+    status, body = _get(server, "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "llm_requests_total" in text
+    assert "llm_ttft_seconds" in text
+    assert 'quantile="0.99"' in text
