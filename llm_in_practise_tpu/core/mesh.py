@@ -54,7 +54,7 @@ class MeshSpec:
     def sizes(self) -> tuple[int, ...]:
         return (self.data, self.fsdp, self.model, self.expert, self.seq)
 
-    def resolve(self, n_devices: int) -> tuple[int, ...]:
+    def resolve(self, n_devices: int, *, allow_subset: bool = False) -> tuple[int, ...]:
         sizes = list(self.sizes())
         wildcards = [i for i, s in enumerate(sizes) if s == -1]
         if len(wildcards) > 1:
@@ -66,19 +66,38 @@ class MeshSpec:
                     f"{n_devices} devices not divisible by fixed axes product {fixed}"
                 )
             sizes[wildcards[0]] = n_devices // fixed
-        elif fixed != n_devices:
+        elif fixed != n_devices and not (allow_subset and fixed < n_devices):
+            # A silently-undersized mesh would train on a fraction of the
+            # hardware; require explicit opt-in (debug meshes) instead.
             raise ValueError(
-                f"mesh {sizes} wants {fixed} devices but {n_devices} are available"
+                f"mesh {sizes} wants {fixed} devices but {n_devices} are "
+                "available (pass allow_subset=True for a deliberate subset)"
             )
         return tuple(sizes)
 
 
-def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
-    """Build a 5-axis device mesh covering all available devices."""
+def build_mesh(
+    spec: MeshSpec | None = None, devices=None, *, allow_subset: bool = False
+) -> Mesh:
+    """Build a 5-axis device mesh covering all available devices.
+
+    ``allow_subset`` lets a fully-pinned spec use the first N devices (debug
+    meshes) — single-process only: in a multi-process run a subset would
+    hold only the coordinator's devices and hang every other process at the
+    first collective.
+    """
     spec = spec or MeshSpec()
     devices = list(devices if devices is not None else jax.devices())
-    shape = spec.resolve(len(devices))
-    dev_array = np.asarray(devices).reshape(shape)
+    if allow_subset and jax.process_count() > 1:
+        raise ValueError(
+            "allow_subset is single-process only: a device subset in a "
+            "multi-process run would hold only some processes' devices and "
+            "hang the rest at the first collective — size the mesh to the "
+            "full device count instead"
+        )
+    shape = spec.resolve(len(devices), allow_subset=allow_subset)
+    n = math.prod(shape)
+    dev_array = np.asarray(devices[:n]).reshape(shape)
     return Mesh(dev_array, MESH_AXES)
 
 

@@ -85,6 +85,12 @@ class BPETokenizer:
         self.id_to_token = {i: t for t, i in self.vocab.items()}
         self.merge_ranks = {m: i for i, m in enumerate(self.merges)}
         self._cache: dict[str, list[str]] = {}
+        # C++ merge loop when the in-tree extension builds; else pure Python.
+        from llm_in_practise_tpu.data import bpe_native
+
+        self._native = bpe_native.make_encoder(
+            self.vocab, self.merges, self.vocab.get(unk_token)
+        )
         self._special_re = (
             re.compile("(" + "|".join(re.escape(t) for t in self.special_tokens) + ")")
             if self.special_tokens
@@ -245,6 +251,10 @@ class BPETokenizer:
                 ids.append(self.vocab[chunk])
                 continue
             for piece in self._pre_tokenize_static(chunk, self.pre_tokenizer):
+                # NUL would truncate in the C string ABI — Python path then.
+                if self._native is not None and "\x00" not in piece:
+                    ids.extend(self._native.encode_word(piece))
+                    continue
                 for sym in self._bpe(piece):
                     tid = self.vocab.get(sym)
                     if tid is None:

@@ -1,0 +1,85 @@
+"""ctypes bridge from :class:`BPETokenizer` to the C++ encode core.
+
+Replaces the Rust `tokenizers` hot path (SURVEY §2.9: "BPE trainer
+performance … C++ extension if hot"). The Python tokenizer owns training,
+vocab/merges and pre-tokenization; this wrapper ships the per-word merge
+loop to ``native/bpe.cc``. Falls back silently when the .so can't build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from llm_in_practise_tpu import native
+
+
+class NativeBPEEncoder:
+    """Holds a C++ Bpe handle mirroring one tokenizer's vocab + merges."""
+
+    def __init__(self, vocab: dict[str, int], merges, unk_id: int | None):
+        lib = native.load_library("bpe")
+        if lib is None:
+            raise RuntimeError("native bpe unavailable")
+        lib.bpe_create.restype = ctypes.c_void_p
+        lib.bpe_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.bpe_encode_word.restype = ctypes.c_int32
+        lib.bpe_encode_word.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
+        lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+
+        syms = [s.encode() for s in vocab]
+        ids = list(vocab.values())
+        sym_arr = (ctypes.c_char_p * len(syms))(*syms)
+        id_arr = (ctypes.c_int32 * len(ids))(*ids)
+        a = [m[0].encode() for m in merges]
+        b = [m[1].encode() for m in merges]
+        a_arr = (ctypes.c_char_p * len(a))(*a)
+        b_arr = (ctypes.c_char_p * len(b))(*b)
+        self._handle = lib.bpe_create(
+            sym_arr, id_arr, len(syms), a_arr, b_arr, len(a),
+            -1 if unk_id is None else unk_id,
+        )
+        if not self._handle:
+            raise RuntimeError("bpe_create failed")
+        self._cache: dict[str, list[int]] = {}
+
+    def encode_word(self, word: str) -> list[int]:
+        cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        ids = self._encode_uncached(word)
+        if len(self._cache) < 65536:
+            self._cache[word] = ids
+        return ids
+
+    def _encode_uncached(self, word: str) -> list[int]:
+        # Per-call buffer: ctypes calls release the GIL, and the serving
+        # layer encodes from request threads — a shared buffer would let one
+        # call overwrite another's ids mid-read (and poison the cache).
+        data = word.encode()
+        buf = (ctypes.c_int32 * max(4096, 2 * len(data) + 16))()
+        n = self._lib.bpe_encode_word(self._handle, data, buf, len(buf))
+        if n < 0:
+            raise KeyError(f"token in {word!r} not in vocab, no unk")
+        return buf[:n]
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.bpe_destroy(handle)
+            self._handle = None
+
+
+def make_encoder(vocab, merges, unk_id) -> NativeBPEEncoder | None:
+    try:
+        return NativeBPEEncoder(vocab, merges, unk_id)
+    except (RuntimeError, OSError):
+        return None

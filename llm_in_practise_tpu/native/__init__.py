@@ -1,0 +1,64 @@
+"""In-tree native (C++) components, loaded via ctypes.
+
+The reference's native muscle is all third-party (SURVEY §2.9 — torch/ATen,
+NCCL, the Rust `tokenizers` core, bitsandbytes CUDA). The TPU compute path
+here compiles through XLA/Pallas; this package holds the *host-side* native
+pieces, starting with the BPE encode hot loop (``bpe.cc``).
+
+Libraries build on demand with g++ (one `make` in this directory, or
+transparently at first import); every consumer must degrade gracefully to
+its pure-Python path when the toolchain or .so is unavailable — set
+``LLM_TPU_NO_NATIVE=1`` to force that.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS: dict[str, ctypes.CDLL | None] = {}
+
+
+def disabled() -> bool:
+    return os.environ.get("LLM_TPU_NO_NATIVE", "") not in ("", "0")
+
+
+def load_library(name: str) -> ctypes.CDLL | None:
+    """Load ``lib{name}.so``, building it with make/g++ if needed.
+
+    Returns None (and caches the failure) when native is disabled or the
+    build fails — callers fall back to Python.
+    """
+    if disabled():
+        return None
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        path = os.path.join(_DIR, f"lib{name}.so")
+        src = os.path.join(_DIR, f"{name}.cc")
+        lib = None
+        try:
+            if not os.path.exists(path) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(path)
+            ):
+                # Build to a per-process temp name and os.replace (atomic on
+                # POSIX): concurrent processes racing `make` on the shared
+                # output path could otherwise leave a torn .so whose fresh
+                # mtime suppresses every rebuild.
+                tmp = f"lib{name}.{os.getpid()}.tmp.so"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
+                     "-o", tmp, f"{name}.cc"],
+                    cwd=_DIR, check=True, capture_output=True, timeout=120,
+                )
+                os.replace(os.path.join(_DIR, tmp), path)
+            lib = ctypes.CDLL(path)
+        except (OSError, subprocess.SubprocessError):
+            lib = None
+        _LIBS[name] = lib
+        return lib

@@ -1,0 +1,158 @@
+// Native BPE encoder — the in-tree replacement for HF tokenizers' Rust core.
+//
+// The reference trains/encodes BPE through the Rust `tokenizers` wheel
+// (reference DeepSeekLike_spare_MoE_wikitext2.py:54-80). This tree keeps the
+// trainer in Python (llm_in_practise_tpu/data/bpe.py — training is one-off)
+// and moves the per-token merge loop, the encode hot path, to C++. Exposed
+// as a C ABI for ctypes (no pybind11 in the image).
+//
+// Contract (must match BPETokenizer._bpe + encode exactly):
+// - a pre-token arrives as a UTF-8 string; initial symbols are its Unicode
+//   code points,
+// - repeatedly merge the adjacent pair with the lowest merge rank
+//   (leftmost on ties) until no ranked pair remains,
+// - map symbols to vocab ids; unknown symbols map to unk_id (or fail if
+//   unk_id < 0).
+//
+// Build: make -C llm_in_practise_tpu/native   (g++ -O2 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+    size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+        return std::hash<uint64_t>()(
+            (static_cast<uint64_t>(static_cast<uint32_t>(p.first)) << 32) |
+            static_cast<uint32_t>(p.second));
+    }
+};
+
+struct Bpe {
+    // Symbols are interned to dense ids; pair-rank and pair-result tables
+    // are int-keyed so the merge loop never hashes strings.
+    std::unordered_map<std::string, int32_t> sym_id;      // symbol -> intern id
+    std::vector<std::string> sym_str;                     // intern id -> symbol
+    std::vector<int32_t> vocab_of_sym;                    // intern id -> vocab id (-1 = none)
+    std::unordered_map<std::pair<int32_t, int32_t>, int32_t, PairHash> rank;
+    std::unordered_map<std::pair<int32_t, int32_t>, int32_t, PairHash> merged_sym;
+    int32_t unk_id = -1;
+
+    int32_t intern(const std::string& s) {
+        auto it = sym_id.find(s);
+        if (it != sym_id.end()) return it->second;
+        int32_t id = static_cast<int32_t>(sym_str.size());
+        sym_id.emplace(s, id);
+        sym_str.push_back(s);
+        vocab_of_sym.push_back(-1);
+        return id;
+    }
+};
+
+// Split UTF-8 into code-point strings (Python's list(word) semantics).
+void utf8_codepoints(const char* s, std::vector<std::string>& out) {
+    out.clear();
+    const unsigned char* p = reinterpret_cast<const unsigned char*>(s);
+    while (*p) {
+        int len = 1;
+        if ((*p & 0x80u) == 0x00u) len = 1;
+        else if ((*p & 0xE0u) == 0xC0u) len = 2;
+        else if ((*p & 0xF0u) == 0xE0u) len = 3;
+        else if ((*p & 0xF8u) == 0xF0u) len = 4;
+        out.emplace_back(reinterpret_cast<const char*>(p), len);
+        p += len;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create(const char** vocab_syms, const int32_t* vocab_ids, int32_t n_vocab,
+                 const char** merge_a, const char** merge_b, int32_t n_merges,
+                 int32_t unk_id) {
+    Bpe* b = new Bpe();
+    b->unk_id = unk_id;
+    for (int32_t i = 0; i < n_vocab; ++i) {
+        int32_t s = b->intern(vocab_syms[i]);
+        b->vocab_of_sym[s] = vocab_ids[i];
+    }
+    for (int32_t i = 0; i < n_merges; ++i) {
+        int32_t a = b->intern(merge_a[i]);
+        int32_t c = b->intern(merge_b[i]);
+        std::string joined = std::string(merge_a[i]) + merge_b[i];
+        int32_t m = b->intern(joined);
+        std::pair<int32_t, int32_t> key(a, c);
+        if (!b->rank.count(key)) {
+            b->rank.emplace(key, i);
+            b->merged_sym.emplace(key, m);
+        }
+    }
+    return b;
+}
+
+void bpe_destroy(void* h) { delete static_cast<Bpe*>(h); }
+
+// Encode one pre-token. Returns #ids written, -cap-1 if out too small,
+// or -1 on an unknown symbol with no unk. Thread-compatible (read-only).
+int32_t bpe_encode_word(void* h, const char* word, int32_t* out, int32_t cap) {
+    Bpe* b = static_cast<Bpe*>(h);
+    thread_local std::vector<std::string> cps;
+    thread_local std::vector<int32_t> syms;
+    utf8_codepoints(word, cps);
+    syms.clear();
+    constexpr int32_t kUnknownSym = -2;  // never merges, maps to unk
+    for (const auto& cp : cps) {
+        auto it = b->sym_id.find(cp);
+        syms.push_back(it != b->sym_id.end() ? it->second : kUnknownSym);
+    }
+
+    // Lowest-rank-first merge loop (matches BPETokenizer._bpe).
+    while (syms.size() > 1) {
+        int32_t best_rank = INT32_MAX;
+        size_t best_i = 0;
+        for (size_t i = 0; i + 1 < syms.size(); ++i) {
+            auto it = b->rank.find({syms[i], syms[i + 1]});
+            if (it != b->rank.end() && it->second < best_rank) {
+                best_rank = it->second;
+                best_i = i;
+            }
+        }
+        if (best_rank == INT32_MAX) break;
+        syms[best_i] = b->merged_sym[{syms[best_i], syms[best_i + 1]}];
+        syms.erase(syms.begin() + best_i + 1);
+    }
+
+    if (static_cast<int32_t>(syms.size()) > cap) return -cap - 1;
+    int32_t n = 0;
+    for (int32_t s : syms) {
+        int32_t vid = s >= 0 ? b->vocab_of_sym[s] : -1;
+        if (vid < 0) {
+            if (b->unk_id < 0) return -1;
+            vid = b->unk_id;
+        }
+        out[n++] = vid;
+    }
+    return n;
+}
+
+// Batch API: `joined` holds n_words NUL-terminated words back to back.
+// Returns total ids written, or a negative error from bpe_encode_word.
+int32_t bpe_encode_words(void* h, const char* joined, int32_t n_words,
+                         int32_t* out, int32_t cap) {
+    int32_t total = 0;
+    const char* p = joined;
+    for (int32_t w = 0; w < n_words; ++w) {
+        int32_t n = bpe_encode_word(h, p, out + total, cap - total);
+        if (n < 0) return n;
+        total += n;
+        p += std::strlen(p) + 1;
+    }
+    return total;
+}
+
+}  // extern "C"

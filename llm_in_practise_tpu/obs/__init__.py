@@ -1,0 +1,15 @@
+"""Observability: coordinator-gated logging, step metrics, profiling hooks.
+
+SURVEY §5.1/§5.5 — the reference's logging/metrics surface (env-level
+logging, rank-0 gating, rolling loss, epoch timing) plus the profiling it
+lacks; serving-side Prometheus metrics live with the server in
+:mod:`llm_in_practise_tpu.serve.api`.
+"""
+
+from llm_in_practise_tpu.obs.logging import get_logger, setup_logging  # noqa: F401
+from llm_in_practise_tpu.obs.meter import (  # noqa: F401
+    EpochTimer,
+    RollingMean,
+    Throughput,
+    profile_trace,
+)

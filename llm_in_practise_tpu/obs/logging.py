@@ -1,0 +1,65 @@
+"""Logging: env-levelled, coordinator-gated, optional per-process files.
+
+Parity with the reference's logging setup (SURVEY §5.5):
+``logging.basicConfig`` with env-settable level
+(``DeepSeekLike_spare_MoE_wikitext2.py:31-35``), per-rank log files
+(``temp/ddp_gpt_bpe_tokenizer_02.py:33-54`` ``setup_logging``), and rank-0
+gating of console output (``ddp_gpt_wikitext2.py:316-323``). WANDB stays out
+(explicitly disabled in the reference — ``ddp_basics/README.md:47``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def setup_logging(
+    *,
+    level: str | None = None,
+    log_dir: str | None = None,
+    force: bool = False,
+) -> None:
+    """Configure root logging once.
+
+    - ``level`` defaults to env ``LOG_LEVEL`` (reference parity), else INFO.
+    - Console handler only on the coordinator process; with ``log_dir`` every
+      process additionally writes ``proc_{i}.log`` (per-rank file parity).
+    """
+    global _CONFIGURED
+    # An explicit call with arguments always reconfigures — get_logger()'s
+    # implicit default setup must not turn a later
+    # ``setup_logging(log_dir=...)`` into a silent no-op.
+    if _CONFIGURED and not force and level is None and log_dir is None:
+        return
+    from llm_in_practise_tpu.core import dist
+
+    level = (level or os.environ.get("LOG_LEVEL", "INFO")).upper()
+    root = logging.getLogger()
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    if dist.is_coordinator():
+        console = logging.StreamHandler(sys.stderr)
+        console.setFormatter(logging.Formatter(FORMAT))
+        root.addHandler(console)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        fh = logging.FileHandler(
+            os.path.join(log_dir, f"proc_{dist.process_index()}.log")
+        )
+        fh.setFormatter(logging.Formatter(FORMAT))
+        root.addHandler(fh)
+    if not root.handlers:  # non-coordinator without log_dir: swallow quietly
+        root.addHandler(logging.NullHandler())
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    setup_logging()
+    return logging.getLogger(name)

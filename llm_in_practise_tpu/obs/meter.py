@@ -1,0 +1,81 @@
+"""Step timing, throughput, and rolling metrics for the train loop.
+
+The reference reports a rolling last-50-batch loss average
+(``ddp_gpt_wikitext2.py:316-318``), epoch wall-clock
+(``temp/ddp_gpt_bpe_tokenizer_02.py:502-507``), and — on the serving side —
+TTFT/TPOT vocabulary. This module gives the train loop the same numbers
+plus tokens/sec, and a ``jax.profiler`` trace context for deep dives
+(the profiling the reference never wires up — SURVEY §5.1).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+
+import jax
+
+
+class RollingMean:
+    """Rolling mean over the last ``window`` values (last-50 loss parity)."""
+
+    def __init__(self, window: int = 50):
+        self.values: collections.deque[float] = collections.deque(maxlen=window)
+
+    def update(self, v: float) -> float:
+        self.values.append(float(v))
+        return self.mean
+
+    @property
+    def mean(self) -> float:
+        return sum(self.values) / len(self.values) if self.values else 0.0
+
+
+class Throughput:
+    """Tokens/sec + step-time meter. Call :meth:`step` once per train step."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.last = self.t0
+        self.steps = 0
+        self.tokens = 0
+        self.step_time = RollingMean(window=20)
+
+    def step(self, n_tokens: int) -> None:
+        now = time.perf_counter()
+        self.step_time.update(now - self.last)
+        self.last = now
+        self.steps += 1
+        self.tokens += int(n_tokens)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        elapsed = time.perf_counter() - self.t0
+        return self.tokens / elapsed if elapsed > 0 else 0.0
+
+    @property
+    def mean_step_s(self) -> float:
+        return self.step_time.mean
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None):
+    """``with profile_trace("/tmp/trace"):`` — jax.profiler trace around the
+    hot loop; None disables (zero overhead)."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class EpochTimer:
+    def __init__(self):
+        self.start = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.start

@@ -1,3 +1,12 @@
+"""Quantization: NF4 (QLoRA storage), GPTQ, AWQ, W4A16 format, PPL gate.
+
+TPU-native replacements for the reference's quantization stack (SURVEY
+§2.5): bitsandbytes NF4 → :mod:`.nf4`; GPTQModel / llm-compressor GPTQ →
+:mod:`.gptq`; llm-compressor AWQ → :mod:`.awq`; the compressed-tensors
+W4A16 storage scheme → :mod:`.int4`; the vLLM perplexity acceptance eval →
+:mod:`.ppl`.
+"""
+
 from llm_in_practise_tpu.quant.nf4 import (
     NF4Tensor,
     dequantize,
@@ -6,6 +15,19 @@ from llm_in_practise_tpu.quant.nf4 import (
     quantize_tree,
     tree_nbytes,
 )
+from llm_in_practise_tpu.quant.int4 import Int4Tensor, rtn_quantize
+from llm_in_practise_tpu.quant.gptq import (
+    GPTQConfig,
+    gptq_quantize_matrix,
+    quantize_model_gptq,
+)
+from llm_in_practise_tpu.quant.awq import (
+    AWQConfig,
+    AWQTensor,
+    awq_quantize_matrix,
+    quantize_model_awq,
+)
+from llm_in_practise_tpu.quant.ppl import PPLReport, compare_quantized, evaluate_ppl
 
 __all__ = [
     "NF4Tensor",
@@ -14,4 +36,16 @@ __all__ = [
     "quantize",
     "quantize_tree",
     "tree_nbytes",
+    "Int4Tensor",
+    "rtn_quantize",
+    "GPTQConfig",
+    "gptq_quantize_matrix",
+    "quantize_model_gptq",
+    "AWQConfig",
+    "AWQTensor",
+    "awq_quantize_matrix",
+    "quantize_model_awq",
+    "PPLReport",
+    "compare_quantized",
+    "evaluate_ppl",
 ]

@@ -1,0 +1,203 @@
+"""AWQ: activation-aware weight quantization (scale search), in JAX.
+
+The reference runs AWQ through llm-compressor
+(``Quantization/LLM-Compressor/AWQ/quantize_qwen3_4b_awq.py:17-26`` —
+``AWQModifier(targets="Linear", scheme="W4A16", ignore=["lm_head"])`` over
+128 alpaca-gpt4-zh calibration texts). The method: salient weight channels
+are the ones multiplied by large activations; scaling those channels *up*
+before int4 rounding (and folding the inverse into the activations)
+preserves them. Per layer:
+
+1. channel importance ``s_x = mean(|X|)`` over calibration inputs,
+2. grid search ``alpha ∈ [0, 1]``: scale ``s = s_x^alpha`` (normalized),
+   quantize ``W * s`` with RTN int4 groups, measure ``||X W - (X/s)(sW)_q||``,
+3. keep the best alpha; store the scaled-quantized weight and fold ``1/s``
+   into the weight's *input* side so call sites are unchanged.
+
+The whole search is jittable (static grid, vmapped over alpha).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.quant import int4
+from llm_in_practise_tpu.quant.gptq import accumulate_dense_stats
+from llm_in_practise_tpu.utils.tree import path_str
+
+
+@dataclasses.dataclass(frozen=True)
+class AWQConfig:
+    group_size: int = 128
+    sym: bool = True
+    n_grid: int = 20  # alpha grid resolution over [0, 1]
+
+
+@dataclasses.dataclass
+class AWQTensor:
+    """An AWQ-quantized kernel: int4 codes of ``W·s`` plus the fold-in scale.
+
+    Dequant applies ``diag(1/s) @ decode(q)`` so the layer computes
+    ``x @ W_hat`` with unchanged calling convention (llm-compressor instead
+    folds ``1/s`` into the previous layernorm; keeping it local makes the
+    tensor self-contained for checkpointing).
+    """
+
+    q: int4.Int4Tensor
+    inv_scale: jax.Array  # (in,) f32 — multiply rows after decode
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.inv_scale.nbytes
+
+
+jax.tree_util.register_pytree_node(
+    AWQTensor,
+    lambda t: ((t.q, t.inv_scale), None),
+    lambda _, leaves: AWQTensor(*leaves),
+)
+
+
+def decode(t: AWQTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (t.inv_scale[:, None] * int4.decode(t.q, jnp.float32)).astype(dtype)
+
+
+def _rtn_values(w: jax.Array, group_size: int, sym: bool) -> jax.Array:
+    """RTN-quantized *values* of ``w`` (in, out), same grid as int4.encode."""
+    d_in, d_out = w.shape
+    gs = min(group_size, d_in)
+    groups = w.reshape(-1, gs, d_out)
+    scales, zeros = jax.vmap(
+        lambda g: int4.quant_params_for_group(g, sym=sym)
+    )(groups)
+    code = jnp.clip(jnp.round(groups / scales[:, None] + zeros[:, None]), 0, 15)
+    return ((code - zeros[:, None]) * scales[:, None]).reshape(d_in, d_out)
+
+
+def awq_search_from_stats(
+    w: jax.Array,
+    gram: jax.Array,
+    mean_abs: jax.Array,
+    cfg: AWQConfig = AWQConfig(),
+) -> tuple[jax.Array, jax.Array]:
+    """Scale search from streaming stats (``gram = ΣXᵀX``, ``mean_abs =
+    mean|x|``) instead of materialized activations: with ``A = W - D·Wq``
+    (``D = diag(1/s)``), the reconstruction error ``‖XW − (X/s)Wq‖²`` equals
+    ``tr(AᵀGA)`` — so calibration memory is (in, in), not (n_tokens, in).
+
+    Returns (best_scale (in,), best summed-squared error).
+    """
+    w = w.astype(jnp.float32)
+    s_x = jnp.maximum(mean_abs, 1e-8)  # (in,)
+
+    def loss_for_alpha(alpha):
+        s = s_x ** alpha
+        s = s / jnp.sqrt(jnp.max(s) * jnp.min(s))  # normalize (AWQ impl trick)
+        s = jnp.maximum(s, 1e-6)
+        wq = _rtn_values(w * s[:, None], cfg.group_size, cfg.sym)
+        a = w - wq / s[:, None]
+        return jnp.sum(a * (gram @ a))
+
+    alphas = jnp.linspace(0.0, 1.0, cfg.n_grid)
+    losses = jax.lax.map(loss_for_alpha, alphas)
+    best = alphas[jnp.argmin(losses)]
+    s = s_x ** best
+    s = s / jnp.sqrt(jnp.max(s) * jnp.min(s))
+    return jnp.maximum(s, 1e-6), jnp.min(losses)
+
+
+def awq_search_matrix(
+    w: jax.Array,
+    x: jax.Array,
+    cfg: AWQConfig = AWQConfig(),
+) -> tuple[jax.Array, jax.Array]:
+    """Find the best per-input-channel scale for ``w`` (in, out).
+
+    ``x``: calibration inputs (n, in). Returns (best_scale (in,), best_err
+    as mean squared error, matching a direct ``‖XW − (X/s)Wq‖²/n·d_out``).
+    """
+    x = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    scale, err = awq_search_from_stats(
+        w, x.T @ x, jnp.mean(jnp.abs(x), axis=0), cfg
+    )
+    return scale, err / (x.shape[0] * w.shape[1])
+
+
+def awq_quantize_from_stats(
+    w: jax.Array, gram: jax.Array, mean_abs: jax.Array,
+    cfg: AWQConfig = AWQConfig(),
+) -> AWQTensor:
+    scale, _ = awq_search_from_stats(w, gram, mean_abs, cfg)
+    q = int4.rtn_quantize(
+        w.astype(jnp.float32) * scale[:, None],
+        group_size=min(cfg.group_size, w.shape[0]), sym=cfg.sym,
+    )
+    return AWQTensor(q, 1.0 / scale)
+
+
+awq_quantize_from_stats_jit = jax.jit(
+    awq_quantize_from_stats, static_argnums=(3,)
+)
+
+
+def awq_quantize_matrix(
+    w: jax.Array, x: jax.Array, cfg: AWQConfig = AWQConfig()
+) -> AWQTensor:
+    x = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+    return awq_quantize_from_stats(
+        w, x.T @ x, jnp.mean(jnp.abs(x), axis=0), cfg
+    )
+
+
+awq_quantize_matrix_jit = jax.jit(awq_quantize_matrix, static_argnums=(2,))
+
+
+def quantize_model_awq(
+    model,
+    params,
+    calib_batches,
+    cfg: AWQConfig = AWQConfig(),
+    *,
+    target: Callable[[str], bool] | None = None,
+):
+    """AWQ-quantize every captured Dense kernel (default: all but those the
+    ``target`` predicate rejects — the reference ignores ``lm_head``)."""
+    if target is None:
+        target = lambda key: "lm_head" not in key
+    stats = accumulate_dense_stats(model, params, calib_batches, target=target)
+
+    def maybe_q(path, leaf):
+        key = path_str(path)
+        if key in stats and getattr(leaf, "ndim", 0) == 2:
+            gs = min(cfg.group_size, leaf.shape[0])
+            if leaf.shape[0] % gs == 0:
+                st = stats[key]
+                return awq_quantize_from_stats_jit(
+                    leaf, st.gram, st.mean_abs, cfg
+                )
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_q, params)
+
+
+def dequantize_tree(qtree, dtype=jnp.bfloat16):
+    """Materialize AWQ/int4 nodes back to dense arrays."""
+    def leaf(x):
+        if isinstance(x, AWQTensor):
+            return decode(x, dtype)
+        if isinstance(x, int4.Int4Tensor):
+            return int4.decode(x, dtype)
+        return x
+
+    return jax.tree_util.tree_map(
+        leaf, qtree,
+        is_leaf=lambda x: isinstance(x, (AWQTensor, int4.Int4Tensor)),
+    )

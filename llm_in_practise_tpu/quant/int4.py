@@ -1,0 +1,112 @@
+"""W4A16 packed integer-4 storage — the export format GPTQ and AWQ share.
+
+The reference's PTQ pipelines all emit a 4-bit-weight/16-bit-activation
+format consumed by vLLM (``compressed-tensors`` W4A16 scheme —
+``Quantization/LLM-Compressor/AWQ/quantize_qwen3_4b_awq.py:17-26``,
+``GPTQModel QuantizeConfig(bits=4, group_size=128)`` —
+``Quantization/GPTQModel/quantize_qwen3_4b_gptq.py:16-43``). This module is
+that storage layer for the TPU stack: group-wise affine int4 codes packed two
+per byte, with per-(group, out-channel) scales and zero-points. Dequant is a
+gather-free unpack + affine rescale that XLA fuses into the consuming
+matmul; the serving path can swap in a Pallas fused dequant-matmul.
+
+Convention: weights are stored in flax kernel layout ``(in_features,
+out_features)``; groups run along the *input* dimension (matching GPTQ/AWQ
+group_size semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class Int4Tensor:
+    """Group-quantized int4 weight (pytree node).
+
+    ``codes`` are unsigned nibbles in [0, 15]; the affine map is
+    ``w = (code - zero) * scale`` with per-group-per-column scale/zero.
+    """
+
+    packed: jax.Array   # (in/2, out) uint8 — two input-dim nibbles per byte
+    scales: jax.Array   # (n_groups, out) f32
+    zeros: jax.Array    # (n_groups, out) f32 (fractional zero-points allowed)
+    group_size: int
+    shape: tuple[int, ...]  # (in, out)
+
+    @property
+    def nbytes(self) -> int:
+        return self.packed.nbytes + self.scales.nbytes + self.zeros.nbytes
+
+    @property
+    def bits_per_param(self) -> float:
+        return 8.0 * self.nbytes / (self.shape[0] * self.shape[1])
+
+
+jax.tree_util.register_pytree_node(
+    Int4Tensor,
+    lambda t: ((t.packed, t.scales, t.zeros), (t.group_size, t.shape)),
+    lambda aux, leaves: Int4Tensor(*leaves, group_size=aux[0], shape=aux[1]),
+)
+
+
+def quant_params_for_group(w_group: jax.Array, *, sym: bool) -> tuple[jax.Array, jax.Array]:
+    """Per-column (scale, zero) for one ``(group_size, out)`` weight block."""
+    if sym:
+        absmax = jnp.max(jnp.abs(w_group), axis=0)
+        scale = jnp.maximum(absmax / 7.0, 1e-12)
+        zero = jnp.full_like(scale, 8.0)
+    else:
+        lo = jnp.minimum(jnp.min(w_group, axis=0), 0.0)
+        hi = jnp.maximum(jnp.max(w_group, axis=0), 0.0)
+        scale = jnp.maximum((hi - lo) / 15.0, 1e-12)
+        zero = jnp.round(-lo / scale)
+    return scale, zero
+
+
+def quantize_column(w: jax.Array, scale: jax.Array, zero: jax.Array) -> jax.Array:
+    """Snap one weight column (out,) to its int4 grid, returning *values*."""
+    code = jnp.clip(jnp.round(w / scale + zero), 0, 15)
+    return (code - zero) * scale
+
+
+def encode(w: jax.Array, scales: jax.Array, zeros: jax.Array, group_size: int) -> Int4Tensor:
+    """Quantize ``w`` (in, out) to packed codes given per-group params."""
+    d_in, d_out = w.shape
+    n_groups = scales.shape[0]
+    g = jnp.repeat(jnp.arange(n_groups), group_size)[:d_in]
+    code = jnp.clip(jnp.round(w / scales[g] + zeros[g]), 0, 15).astype(jnp.uint8)
+    if d_in % 2:
+        code = jnp.pad(code, ((0, 1), (0, 0)))
+    packed = (code[0::2] << 4) | code[1::2]
+    return Int4Tensor(packed, scales, zeros, group_size, (d_in, d_out))
+
+
+def decode(t: Int4Tensor, dtype=jnp.bfloat16) -> jax.Array:
+    """Unpack to dense ``(in, out)`` weights."""
+    hi = (t.packed >> 4).astype(jnp.float32)
+    lo = (t.packed & 0xF).astype(jnp.float32)
+    code = jnp.stack([hi, lo], axis=1).reshape(-1, t.shape[1])[: t.shape[0]]
+    n_groups = t.scales.shape[0]
+    g = jnp.repeat(jnp.arange(n_groups), t.group_size)[: t.shape[0]]
+    return ((code - t.zeros[g]) * t.scales[g]).astype(dtype)
+
+
+def rtn_quantize(w: jax.Array, *, group_size: int = 128, sym: bool = True) -> Int4Tensor:
+    """Round-to-nearest baseline (no Hessian compensation) — what GPTQ/AWQ
+    are measured against in the tests."""
+    d_in, _ = w.shape
+    pad = (-d_in) % group_size
+    wp = jnp.pad(w, ((0, pad), (0, 0))) if pad else w
+    groups = wp.reshape(-1, group_size, w.shape[1])
+    scales, zeros = jax.vmap(lambda g: quant_params_for_group(g, sym=sym))(groups)
+    return encode(w, scales, zeros, group_size)
+
+
+def dequant_matmul(x: jax.Array, t: Int4Tensor) -> jax.Array:
+    """``x @ W`` with on-the-fly dequant (XLA fuses unpack into the matmul)."""
+    return x @ decode(t, x.dtype)

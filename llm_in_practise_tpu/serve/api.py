@@ -146,7 +146,10 @@ class OpenAIServer:
             def log_message(self, *args):  # quiet; obs handles logging
                 pass
 
+            _responded = False
+
             def _json(self, status: int, payload: dict):
+                self._responded = True
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -155,16 +158,23 @@ class OpenAIServer:
                 self.wfile.write(body)
 
             def _sse(self, events):
+                self._responded = True
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
                 self.end_headers()
                 try:
-                    for event in events:
-                        payload = f"data: {json.dumps(event)}\n\n".encode()
-                        self.wfile.write(payload)
-                        self.wfile.flush()
+                    try:
+                        for event in events:
+                            payload = f"data: {json.dumps(event)}\n\n".encode()
+                            self.wfile.write(payload)
+                            self.wfile.flush()
+                    except Exception as e:  # noqa: BLE001 — headers are out;
+                        # surface the fault as an SSE error event, then DONE.
+                        err = {"error": {"message": f"{type(e).__name__}: {e}",
+                                         "type": "internal_error"}}
+                        self.wfile.write(f"data: {json.dumps(err)}\n\n".encode())
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
@@ -200,7 +210,19 @@ class OpenAIServer:
                     body = json.loads(self.rfile.read(length) or b"{}")
                 except (ValueError, json.JSONDecodeError):
                     return self._json(400, {"error": {"message": "invalid JSON body"}})
-                return server.handle_chat(body, self._json, self._sse)
+                try:
+                    return server.handle_chat(body, self._json, self._sse)
+                except Exception as e:  # noqa: BLE001 — a handler fault must
+                    # still answer the client, not drop the connection. If a
+                    # response already went out (SSE underway), sending a
+                    # second status line would corrupt the stream — _sse has
+                    # its own in-band error path; just stop.
+                    if self._responded:
+                        return None
+                    return self._json(500, {"error": {
+                        "message": f"{type(e).__name__}: {e}",
+                        "type": "internal_error",
+                    }})
 
         return Handler
 

@@ -1,0 +1,9 @@
+"""Training: jitted steps, optimizers, schedules, and the Trainer loop."""
+
+from llm_in_practise_tpu.train.step import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from llm_in_practise_tpu.train.trainer import Trainer, TrainerConfig  # noqa: F401

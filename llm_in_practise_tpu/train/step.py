@@ -32,9 +32,15 @@ def make_train_step(
     *,
     loss_fn: Callable | None = None,
     donate: bool = True,
+    offload_opt: bool = False,
 ) -> Callable[[TrainState, tuple[jax.Array, jax.Array]], tuple[TrainState, dict]]:
     """Build the jitted step. ``loss_fn(params, apply_fn, batch, rng)`` may be
     overridden (e.g. MoE aux losses); default is next-token cross-entropy.
+
+    ``offload_opt`` (ZeRO-Offload parity): the optimizer state arrives in
+    pinned host memory, is streamed to device inside the compiled step, and
+    is parked back on the host after — DeepSpeed's CPUAdam data motion with
+    the transfer schedule owned by XLA.
     """
 
     def default_loss(params, apply_fn, batch, rng):
@@ -48,6 +54,12 @@ def make_train_step(
     loss_fn = loss_fn or default_loss
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict[str, Any]]:
+        if offload_opt:
+            from jax.memory import Space
+
+            state = state.replace(
+                opt_state=jax.device_put(state.opt_state, Space.Device)
+            )
         rng = jax.random.fold_in(state.rng, state.step)
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.apply_fn, batch, rng
@@ -56,7 +68,21 @@ def make_train_step(
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), **aux}
         return new_state, metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    if not offload_opt:
+        return jitted
+
+    def offloaded_step(state, batch):
+        host_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, state.opt_state
+        )
+        new_state, metrics = jitted(state, batch)
+        new_state = new_state.replace(
+            opt_state=jax.device_put(new_state.opt_state, host_shardings)
+        )
+        return new_state, metrics
+
+    return offloaded_step
 
 
 def make_eval_step(*, loss_fn: Callable | None = None):

@@ -1,0 +1,87 @@
+"""C++ BPE encoder: builds via make/g++ and matches the pure-Python merge
+loop token-for-token (the correctness contract from SURVEY §2.9)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from llm_in_practise_tpu import native
+from llm_in_practise_tpu.data.bpe import BPETokenizer
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "TPUs multiply matrices; the systolic array hums along in bfloat16",
+    "ünïcodé — 中文字符 and emoji ☕ mix with ASCII",
+    "low lower lowest newer newest wider widest",
+] * 8
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return BPETokenizer.train(CORPUS, vocab_size=400, min_frequency=1)
+
+
+def test_native_library_builds(tok):
+    assert native.load_library("bpe") is not None, "g++ build failed"
+    assert tok._native is not None
+
+
+def test_native_matches_python(tok):
+    assert tok._native is not None
+    texts = CORPUS + [
+        "completely unseen wörds — 你好世界 mixed ☕☕ input!!",
+        "",
+        "a",
+        "    leading and trailing spaces   ",
+    ]
+    for text in texts:
+        native_ids = tok.encode(text)
+        # Force the pure-Python path on a fresh tokenizer state.
+        saved, tok._native = tok._native, None
+        tok._cache.clear()
+        py_ids = tok.encode(text)
+        tok._native = saved
+        assert native_ids == py_ids, text
+        assert tok.decode(py_ids, skip_special_tokens=False).replace(
+            "[UNK]", ""
+        ) or text == ""
+
+
+def test_native_roundtrip_decode(tok):
+    text = "the quick brown fox"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+
+
+def test_whitespace_pretokenizer_variant():
+    tok = BPETokenizer.train(
+        CORPUS, vocab_size=300, min_frequency=1, pre_tokenizer="whitespace"
+    )
+    for text in CORPUS[:4]:
+        native_ids = tok.encode(text)
+        saved, tok._native = tok._native, None
+        py_ids = tok.encode(text)
+        tok._native = saved
+        assert native_ids == py_ids
+
+
+def test_nul_bytes_match_python_path():
+    tok = BPETokenizer.train(
+        CORPUS, vocab_size=300, min_frequency=1, pre_tokenizer="whitespace"
+    )
+    text = "foo\x00bar baz"
+    native_ids = tok.encode(text)
+    saved, tok._native = tok._native, None
+    tok._cache.clear()
+    py_ids = tok.encode(text)
+    tok._native = saved
+    assert native_ids == py_ids
+
+
+def test_env_var_disables_native(monkeypatch):
+    monkeypatch.setenv("LLM_TPU_NO_NATIVE", "1")
+    assert native.disabled()
+    # Fresh loads honor the switch (the disabled check precedes the cache).
+    from llm_in_practise_tpu.data import bpe_native
+    assert bpe_native.make_encoder({"a": 0}, [], None) is None
