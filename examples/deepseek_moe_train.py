@@ -1,0 +1,107 @@
+"""DeepSeekLike (RoPE + MLA + sparse MoE) pretraining with full CLI surface.
+
+TPU-native counterpart of the reference's
+``transformer_basics/DeepSeekLike_spare_MoE_wikitext2.py`` ``main:422-582``:
+arg-parsed hyperparameters with validation, BPE tokenizer trained on the
+corpus, StepLR-style decayed schedule, gradient clipping, rotating
+checkpoints, and expert-parallel placement (the ``expert`` mesh axis — EP is
+beyond the reference, which loops experts on one device, ``:309-329``).
+
+Run: ``python examples/deepseek_moe_train.py [--experts 8 --top_k 2 --ep N]``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_in_practise_tpu.data import (
+    BPETokenizer,
+    block_chunk,
+    prepare_data,
+    tokenize_corpus,
+    train_val_split,
+)
+from llm_in_practise_tpu.infer.generate import generate
+from llm_in_practise_tpu.models import DeepSeekLike, deepseeklike_config, moe_loss_fn
+from llm_in_practise_tpu.train import Trainer, TrainerConfig
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dataset", default="wikitext-2")
+    p.add_argument("--vocab_size", type=int, default=8000)
+    p.add_argument("--block_size", type=int, default=256)
+    p.add_argument("--n_layer", type=int, default=4)
+    p.add_argument("--n_head", type=int, default=8)
+    p.add_argument("--embed_dim", type=int, default=256)
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--shared_experts", type=int, default=1)
+    p.add_argument("--top_k", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--clip_norm", type=float, default=1.0)
+    p.add_argument("--max_lines", type=int, default=4000)
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel mesh size")
+    p.add_argument("--keep_checkpoints", type=int, default=5)
+    p.add_argument("--ckpt_dir", default="/tmp/deepseek_moe_ckpt")
+    p.add_argument("--tokenizer_path", default="/tmp/deepseek_bpe.json")
+    p.add_argument("--prompt", default="the")
+    args = p.parse_args()
+    # validation mirroring the reference's arg checks (:448-453)
+    if args.embed_dim % args.n_head:
+        p.error("embed_dim must be divisible by n_head")
+    if args.top_k > args.experts:
+        p.error("top_k cannot exceed experts")
+    if args.experts % args.ep:
+        p.error("experts must be divisible by the expert-parallel size")
+    return args
+
+
+def main():
+    args = parse_args()
+    print(f"devices: {len(jax.devices())}")
+
+    lines = prepare_data(args.dataset)[: args.max_lines]
+    if os.path.exists(args.tokenizer_path):
+        tok = BPETokenizer.load(args.tokenizer_path)
+    else:
+        tok = BPETokenizer.train(lines, vocab_size=args.vocab_size)
+        tok.save(args.tokenizer_path)
+    ids = tokenize_corpus(lines, tok)
+    x, y = block_chunk(ids, args.block_size)
+    tr_idx, va_idx = train_val_split(len(x), val_fraction=0.1, seed=42)
+    (xt, yt), (xv, yv) = (x[tr_idx], y[tr_idx]), (x[va_idx], y[va_idx])
+    print(f"vocab={tok.vocab_size} train_blocks={len(xt)} val_blocks={len(xv)}")
+
+    model = DeepSeekLike(deepseeklike_config(
+        tok.vocab_size, seq_len=args.block_size, n_layer=args.n_layer,
+        n_head=args.n_head, embed_dim=args.embed_dim, n_experts=args.experts,
+        n_shared_experts=args.shared_experts, top_k=args.top_k,
+    ))
+    cfg = TrainerConfig(
+        lr=args.lr, clip_norm=args.clip_norm, epochs=args.epochs,
+        batch_size=args.batch_size, schedule="step",
+        ckpt_dir=args.ckpt_dir, keep_checkpoints=args.keep_checkpoints,
+        strategy="ep" if args.ep > 1 else "ddp", mesh_expert=args.ep,
+    )
+    trainer = Trainer(
+        model, cfg, loss_fn=moe_loss_fn,
+        metadata={"tokenizer_path": args.tokenizer_path, "args": vars(args)},
+    )
+    trainer.train((xt, yt), eval_data=(xv, yv))
+
+    prompt = jnp.asarray(tok.encode(args.prompt))[None, :]
+    out = generate(model, trainer.state.params, prompt, max_new_tokens=40,
+                   temperature=0.8, top_k=50)
+    print("sample:", repr(tok.decode(np.asarray(out[0]).tolist())))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Distributed pretraining — every parallelism strategy, one entry point.
+
+TPU-native counterpart of the reference's distributed-training ladder:
+``ddp_basics/ddp_gpt_wikitext2.py`` (DDP), ``fsdp_basics/fsdp{,2}_gpt_
+wikitext2.py`` (FSDP1/2), the four DeepSpeed stages (``DeepSpeed-GPTLike-
+ZeRO-{1,2,3,Offload}``) and their multi-host variant. There torchrun /
+deepspeed / accelerate each spawn one process per GPU and wrap the model in
+an engine; here the strategy is a NamedSharding placement over one mesh and
+the step is identical for all of them — XLA compiles the collectives.
+
+Config-file precedence mirrors DeepSpeed (file > CLI —
+``DeepSpeed-GPTLike-ZeRO-1.py:194-216``):
+``python examples/dist_train.py --strategy zero3 --config ds_config.json``.
+
+Multi-host: run the same command on every host with
+``--coordinator host0:1234 --process_id N --num_processes M``
+(``jax.distributed.initialize`` replaces MASTER_ADDR/torchrun env plumbing).
+Simulate 8 devices on CPU:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu …``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default=None,
+                   help="JSON TrainerConfig overriding CLI (DeepSpeed precedence)")
+    p.add_argument("--dataset", default="wikitext-2")
+    p.add_argument("--vocab_size", type=int, default=8000)
+    p.add_argument("--block_size", type=int, default=256)
+    p.add_argument("--max_lines", type=int, default=4000)
+    p.add_argument("--tokenizer_path", default="/tmp/dist_bpe.json")
+    # multi-host topology (jax.distributed.initialize)
+    p.add_argument("--coordinator", default=None, help="host:port of process 0")
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    from llm_in_practise_tpu.core import config as config_lib
+    from llm_in_practise_tpu.train import TrainerConfig
+
+    config_lib.add_cli_args(p, TrainerConfig)
+    args = p.parse_args()
+
+    from llm_in_practise_tpu.core import dist
+
+    dist.initialize(
+        coordinator_address=args.coordinator,
+        process_id=args.process_id,
+        num_processes=args.num_processes,
+    )
+
+    import jax
+
+    from llm_in_practise_tpu.data import (
+        BPETokenizer,
+        block_chunk,
+        prepare_data,
+        tokenize_corpus,
+        train_or_load,
+        train_val_split,
+    )
+    from llm_in_practise_tpu.models import GPT, gptlike_config
+    from llm_in_practise_tpu.obs import get_logger
+    from llm_in_practise_tpu.train import Trainer
+
+    log = get_logger("dist_train")
+    log.info("process %d/%d | %d devices (%d local)",
+             dist.process_index(), jax.process_count(),
+             len(jax.devices()), len(jax.local_devices()))
+
+    cfg = TrainerConfig.from_sources(config_file=args.config, cli_namespace=args)
+    log.info("strategy=%s mesh=(data=%d fsdp=%d model=%d expert=%d seq=%d)",
+             cfg.strategy, cfg.mesh_data, cfg.mesh_fsdp, cfg.mesh_model,
+             cfg.mesh_expert, cfg.mesh_seq)
+
+    lines = prepare_data(args.dataset)[: args.max_lines]
+    # rank-0 trains the tokenizer, everyone else loads (the reference's
+    # train-on-rank0 + barrier — temp/ddp_gpt_bpe_tokenizer_02.py:118-180)
+    tok = train_or_load(lambda: lines, args.tokenizer_path,
+                        vocab_size=args.vocab_size)
+    ids = tokenize_corpus(lines, tok)
+    x, y = block_chunk(ids, args.block_size)
+    tr, va = train_val_split(len(x), val_fraction=0.1, seed=42)
+
+    model = GPT(gptlike_config(tok.vocab_size, seq_len=args.block_size))
+    trainer = Trainer(model, cfg, metadata={"tokenizer_path": args.tokenizer_path})
+    history = trainer.train((x[tr], y[tr]), eval_data=(x[va], y[va]))
+    log.info("done: final train loss %.4f", history[-1]["train_loss"])
+
+
+if __name__ == "__main__":
+    main()

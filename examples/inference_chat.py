@@ -1,0 +1,119 @@
+"""Checkpoint inference ladder: single-shot, sampling, multi-turn chat.
+
+TPU-native counterpart of the reference's ``Scripts/inference/01..04-*.py``
+(load → generate → decode; 04 adds multi-session history) and
+``Fine-Tuning/inferences.py:29-86`` (ChatML prompt build over turns). Loads
+the merged model from ``examples/merge_lora.py`` (or any ``save_named``
+checkpoint + tokenizer), keeps a rolling message history, renders ChatML,
+and samples with temperature/top-p. ``--stream`` prints tokens as they
+decode (the ``TextIteratorStreamer`` behavior of ``06-…-streaming-infr.py``).
+
+Run: ``python examples/inference_chat.py --prompt "Who are you?"``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from llm_in_practise_tpu.ckpt import checkpoint as ckpt
+from llm_in_practise_tpu.data import BPETokenizer
+from llm_in_practise_tpu.data.sft import IM_END, IM_START, render_chatml
+from llm_in_practise_tpu.infer.generate import generate, make_decode_fns
+from llm_in_practise_tpu.infer.sampling import sample_token
+from llm_in_practise_tpu.models import Qwen3, Qwen3Config
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_path", default="/tmp/qwen3_merged/model.msgpack")
+    p.add_argument("--tokenizer_path", default="/tmp/qwen3_sft_bpe.json")
+    p.add_argument(
+        "--system",
+        default="You are a helpful assistant named MyBot, trained by MyTeam.",
+        help="system prompt (default matches examples/qwen3_lora_sft.py); "
+             "pass '' for none",
+    )
+    p.add_argument("--prompt", default="Who are you?",
+                   help="single-shot prompt; omit --interactive for one turn")
+    p.add_argument("--interactive", action="store_true")
+    p.add_argument("--max_new_tokens", type=int, default=48)
+    p.add_argument("--temperature", type=float, default=0.7)
+    p.add_argument("--top_p", type=float, default=0.9)
+    p.add_argument("--greedy", action="store_true")
+    p.add_argument("--stream", action="store_true")
+    args = p.parse_args()
+
+    tok = BPETokenizer.load(args.tokenizer_path)
+    params, meta = ckpt.restore_checkpoint(args.model_path)
+    model = Qwen3(Qwen3Config.from_dict(meta["config"]))
+    eos = tok.token_to_id(IM_END)
+
+    history: list[dict] = []
+    if args.system:
+        history.append({"role": "system", "content": args.system})
+
+    def answer(user_text: str) -> str:
+        history.append({"role": "user", "content": user_text})
+        prompt = render_chatml(history) + f"{IM_START}assistant\n"
+        ids = jnp.asarray(tok.encode(prompt))[None, :]
+        if args.stream:
+            # Incremental prefill+decode (the streamer-thread pattern of the
+            # reference collapses to a plain loop over the jitted step).
+            import jax
+
+            cache = model.init_cache(1, model.config.max_seq_len,
+                                     dtype=jnp.float32)
+            prefill, decode_step = make_decode_fns(model)
+            logits, cache = prefill(params, ids, cache)
+            rng = jax.random.PRNGKey(0)
+            out_ids: list[int] = []
+            shown = ""
+            text = ""
+            for _ in range(args.max_new_tokens):
+                rng, step_rng = jax.random.split(rng)
+                tok_id = int(sample_token(
+                    step_rng, logits, temperature=args.temperature,
+                    top_p=args.top_p, greedy=args.greedy,
+                )[0])
+                if eos is not None and tok_id == eos:
+                    break
+                out_ids.append(tok_id)
+                text = tok.decode(out_ids)
+                print(text[len(shown):], end="", flush=True)
+                shown = text
+                logits, cache = decode_step(
+                    params, jnp.asarray([tok_id], jnp.int32), cache)
+            print()
+        else:
+            out = generate(
+                model, params, ids, max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, top_p=args.top_p,
+                greedy=args.greedy, eos_id=eos,
+            )
+            text = tok.decode(np.asarray(out[0]).tolist()[ids.shape[1]:])
+            print(text.strip())
+        history.append({"role": "assistant", "content": text.strip()})
+        return text
+
+    if args.interactive:
+        print("chat (empty line to exit)")
+        while True:
+            try:
+                user = input("> ").strip()
+            except EOFError:
+                break
+            if not user:
+                break
+            answer(user)
+    else:
+        print(f"> {args.prompt}")
+        answer(args.prompt)
+
+
+if __name__ == "__main__":
+    main()

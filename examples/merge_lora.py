@@ -1,0 +1,69 @@
+"""Merge a LoRA adapter into base weights and save the merged model.
+
+TPU-native counterpart of the reference's
+``Scripts/fine-tuning/02-merge-lora-adapter-and-model.py:27-38``
+(``PeftModel.from_pretrained`` → ``merge_and_unload()`` → save): restore the
+adapter-only checkpoint produced by ``examples/qwen3_lora_sft.py``, fold
+``B@A·(alpha/r)`` into each targeted kernel, and write a standalone
+checkpoint the inference/serving path loads with no PEFT machinery.
+
+Run: ``python examples/merge_lora.py --adapter_dir /tmp/qwen3_lora_adapter``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.ckpt import checkpoint as ckpt
+from llm_in_practise_tpu.data import BPETokenizer
+from llm_in_practise_tpu.models import Qwen3, qwen3_config
+from llm_in_practise_tpu.peft import LoRAConfig, merge_lora
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--adapter_dir", default="/tmp/qwen3_lora_adapter")
+    p.add_argument("--model_dir", default=None,
+                   help="HF Qwen3 dir; default rebuilds the tiny SFT model")
+    p.add_argument("--tokenizer_path", default="/tmp/qwen3_sft_bpe.json")
+    p.add_argument("--out_dir", default="/tmp/qwen3_merged")
+    args = p.parse_args()
+
+    adapter_path = os.path.join(args.adapter_dir, "adapter.msgpack")
+    lora_params, meta = ckpt.restore_checkpoint(adapter_path)
+    lcfg = LoRAConfig.from_dict(meta["lora_config"])
+    print(f"adapter: {adapter_path} (r={lcfg.r}, alpha={lcfg.alpha})")
+
+    if args.model_dir:
+        from llm_in_practise_tpu.models import hf_loader
+
+        cfg = hf_loader.load_config(args.model_dir)
+        params = hf_loader.load_qwen3(args.model_dir)[1]
+    else:
+        tok = BPETokenizer.load(args.tokenizer_path)
+        cfg = qwen3_config(tok.vocab_size, max_seq_len=128,
+                           compute_dtype="float32")
+        params = Qwen3(cfg).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32),
+            deterministic=True,
+        )["params"]
+
+    merged = merge_lora(params, lora_params, lcfg)
+    path = ckpt.save_named(
+        args.out_dir, merged, "model", metadata={"config": cfg.to_dict()},
+    )
+    print(f"merged model -> {path}")
+    if args.model_dir:
+        from llm_in_practise_tpu.models import hf_loader
+
+        hf_loader.save_qwen3(jax.device_get(merged), cfg, args.out_dir)
+        print(f"HF safetensors export -> {args.out_dir}")
+
+
+if __name__ == "__main__":
+    main()

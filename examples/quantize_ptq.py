@@ -1,0 +1,123 @@
+"""Post-training quantization (GPTQ / AWQ) with the PPL acceptance gate.
+
+TPU-native counterpart of the reference's quantization pipelines:
+``Quantization/GPTQModel/quantize_qwen3_4b_gptq.py:16-50`` (GPTQ bits=4
+group_size=128 over calibration texts), ``Quantization/LLM-Compressor/AWQ/
+quantize_qwen3_4b_awq.py:17-60`` (AWQ W4A16, ignore lm_head, oneshot), and
+the eval twins ``eval_qwen3_4b_gptq.py:11-81``: perplexity of the quantized
+model vs the FP16 reference with the <9.0 acceptance threshold.
+
+Run: ``python examples/quantize_ptq.py --method awq`` (tiny in-tree model;
+pass ``--model_path`` + ``--tokenizer_path`` for a trained checkpoint).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_in_practise_tpu.ckpt import checkpoint as ckpt
+from llm_in_practise_tpu.data import BPETokenizer, prepare_data
+from llm_in_practise_tpu.models import GPT, Qwen3, Qwen3Config, gptlike_config
+from llm_in_practise_tpu.quant import (
+    AWQConfig,
+    GPTQConfig,
+    compare_quantized,
+    quantize_model_awq,
+    quantize_model_gptq,
+)
+from llm_in_practise_tpu.quant.awq import dequantize_tree
+from llm_in_practise_tpu.quant.ppl import make_batches
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--method", default="awq", choices=["gptq", "awq"])
+    p.add_argument("--group_size", type=int, default=32)
+    p.add_argument("--model_path", default=None,
+                   help="save_named checkpoint (e.g. /tmp/qwen3_merged/model.msgpack)")
+    p.add_argument("--tokenizer_path", default=None)
+    p.add_argument("--n_calib", type=int, default=16)
+    p.add_argument("--max_len", type=int, default=128)
+    p.add_argument("--ppl_threshold", type=float, default=9.0)
+    p.add_argument("--out_dir", default="/tmp/quantized_model")
+    args = p.parse_args()
+
+    if args.model_path and args.tokenizer_path:
+        tok = BPETokenizer.load(args.tokenizer_path)
+        params, meta = ckpt.restore_checkpoint(args.model_path)
+        model = Qwen3(Qwen3Config.from_dict(meta["config"]))
+        cfg_dict = meta["config"]
+    else:
+        # Hermetic demo: quickly pretrain a small GPT so PPL is meaningful.
+        from llm_in_practise_tpu.data import block_chunk, tokenize_corpus
+        from llm_in_practise_tpu.train import Trainer, TrainerConfig
+
+        lines = prepare_data("wikitext-2")[:400]
+        tok = BPETokenizer.train(lines, vocab_size=800)
+        ids = tokenize_corpus(lines, tok)
+        x, y = block_chunk(ids, 64)
+        model = GPT(gptlike_config(tok.vocab_size, seq_len=64, n_layer=2,
+                                   embed_dim=128, n_head=4, dropout=0.0))
+        trainer = Trainer(model, TrainerConfig(lr=1e-3, epochs=2,
+                                               batch_size=16, strategy="ddp"))
+        trainer.train((x, y))
+        params = jax.device_get(trainer.state.params)
+        cfg_dict = model.config.to_dict()
+
+    # Calibration set (the reference uses alpaca-gpt4-zh[:128] text concat).
+    calib_lines = prepare_data("wikitext-2")[: 50 * args.n_calib]
+    calib_ids = [tok.encode(t)[: args.max_len] for t in calib_lines]
+    calib_ids = [c for c in calib_ids if len(c) >= 8][: args.n_calib]
+    calib_batches = [
+        jnp.asarray(np.asarray(c)[None, :], jnp.int32) for c in calib_ids
+    ]
+    print(f"calibration: {len(calib_batches)} sequences")
+
+    if args.method == "gptq":
+        qparams = quantize_model_gptq(
+            model, params, calib_batches,
+            GPTQConfig(group_size=args.group_size),
+            target=lambda key: "lm_head" not in key and "embed" not in key,
+        )
+    else:
+        qparams = quantize_model_awq(
+            model, params, calib_batches,
+            AWQConfig(group_size=args.group_size),
+            target=lambda key: "lm_head" not in key and "embed" not in key,
+        )
+
+    # PPL gate (eval_qwen3_4b_gptq.py:74-81 semantics).
+    eval_seqs = [tok.encode(t)[: args.max_len]
+                 for t in prepare_data("wikitext-2")[1000:1200]]
+    eval_seqs = [s for s in eval_seqs if len(s) >= 8][:32]
+    batches = list(make_batches(eval_seqs, batch_size=8, max_len=args.max_len))
+
+    def apply_fn(p, input_ids):
+        return model.apply({"params": p}, input_ids, deterministic=True)
+
+    result = compare_quantized(
+        apply_fn, params, dequantize_tree(qparams, jnp.float32), batches,
+        threshold=args.ppl_threshold,
+    )
+    print(f"fp PPL {result['fp_ppl']:.3f} | {args.method} W4 PPL "
+          f"{result['quant_ppl']:.3f} | degradation "
+          f"{result['degradation']:+.3f}")
+    print(result["report"].summary())
+
+    path = ckpt.save_named(
+        args.out_dir, jax.device_get(dequantize_tree(qparams, jnp.float32)),
+        f"model_{args.method}_w4",
+        metadata={"config": cfg_dict, "method": args.method,
+                  "group_size": args.group_size, "ppl": result["quant_ppl"]},
+    )
+    print(f"quantized model -> {path}")
+
+
+if __name__ == "__main__":
+    main()

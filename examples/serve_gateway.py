@@ -1,0 +1,85 @@
+"""Gateway in front of model servers: routing, fallbacks, caching, guard.
+
+TPU-native counterpart of the reference's LiteLLM proxy deployment
+(``Deployment/litellm-proxy/config/litellm-config-router-lb.yaml`` — router
+load balancing, retry policy, cooldowns, fallback chains;
+``litellm-config-cache-redis.yaml`` — response caching;
+``litellm-config-guard.yaml`` + ``llama-guard-wrapper/`` — pre-call
+moderation). One process, no Redis/docker: the same control plane over any
+OpenAI-compatible upstreams (``examples/serve_openai.py`` instances, vLLM…).
+
+Run two backends then:
+``python examples/serve_gateway.py --upstream chat=http://localhost:8000 \\
+  --upstream chat=http://localhost:8001 --fallback chat=chat-backup``
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_in_practise_tpu.serve.gateway import (
+    Gateway,
+    ResponseCache,
+    RetryPolicy,
+    Router,
+    Upstream,
+)
+from llm_in_practise_tpu.serve.moderation import ModerationService, gateway_hook
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--upstream", action="append", default=[],
+                   metavar="GROUP=URL[@WEIGHT][|MODEL]",
+                   help="repeatable: public model group -> backend URL; "
+                        "|MODEL sets the upstream's own model name when it "
+                        "differs from the group (default: same as group)")
+    p.add_argument("--fallback", action="append", default=[],
+                   metavar="GROUP=FALLBACK_GROUP")
+    p.add_argument("--cache_ttl", type=float, default=300.0)
+    p.add_argument("--semantic_threshold", type=float, default=0.97,
+                   help="cosine threshold for the semantic cache; <=0 disables")
+    p.add_argument("--no_cache", action="store_true")
+    p.add_argument("--moderation", action="store_true",
+                   help="enable the pre-call guard hook")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=4000)
+    args = p.parse_args()
+
+    upstreams = []
+    # default pairs with examples/serve_openai.py's default model_name
+    for spec in args.upstream or ["chat=http://127.0.0.1:8000|qwen3-tpu"]:
+        group, _, rest = spec.partition("=")
+        rest, _, model = rest.partition("|")
+        url, _, weight = rest.partition("@")
+        upstreams.append(Upstream(
+            url.rstrip("/"), model=model or group, group=group,
+            weight=float(weight) if weight else 1.0,
+        ))
+    fallbacks: dict[str, list[str]] = {}
+    for spec in args.fallback:
+        group, _, fb = spec.partition("=")
+        fallbacks.setdefault(group, []).append(fb)
+
+    cache = None
+    if not args.no_cache:
+        thr = args.semantic_threshold if args.semantic_threshold > 0 else None
+        cache = ResponseCache(ttl_s=args.cache_ttl, semantic_threshold=thr)
+
+    gw = Gateway(
+        Router(upstreams),
+        retry_policy=RetryPolicy(),
+        cache=cache,
+        fallbacks=fallbacks,
+        moderation=gateway_hook(ModerationService()) if args.moderation else None,
+    )
+    for u in upstreams:
+        print(f"upstream {u.group}: {u.base_url} (weight {u.weight})")
+    print(f"gateway on {args.host}:{args.port}")
+    gw.serve(host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""OpenAI-compatible API server over a checkpoint — the serving entry point.
+
+TPU-native counterpart of the reference's
+``Scripts/inference/07-deepseek1.5b-api-infr.py`` (FastAPI
+``/v1/chat/completions`` with usage accounting and uvicorn main) plus what
+that script stubs out (``stream`` → 501, ``:110-112``): here streaming SSE
+works, requests batch continuously onto KV-cache slots (vLLM-style), and
+``/metrics`` exports the Prometheus names the reference's platform scrapes
+(``Inference_Platfrom/README.md:1676-1692``).
+
+Run: ``python examples/serve_openai.py [--port 8000]`` then
+``curl localhost:8000/v1/chat/completions -d '{"messages": [...]}'``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.ckpt import checkpoint as ckpt
+from llm_in_practise_tpu.data import BPETokenizer
+from llm_in_practise_tpu.models import Qwen3, Qwen3Config
+from llm_in_practise_tpu.serve.api import OpenAIServer
+from llm_in_practise_tpu.serve.engine import InferenceEngine
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_path", default="/tmp/qwen3_merged/model.msgpack")
+    p.add_argument("--tokenizer_path", default="/tmp/qwen3_sft_bpe.json")
+    p.add_argument("--model_name", default="qwen3-tpu")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max_slots", type=int, default=8,
+                   help="concurrent sequences in the continuous batch")
+    p.add_argument("--cache_len", type=int, default=512)
+    p.add_argument("--lora-modules", dest="lora_modules", nargs="*",
+                   default=[], metavar="NAME=PATH",
+                   help="serve LoRA adapters as extra model names "
+                        "(vLLM --lora-modules parity)")
+    args = p.parse_args()
+
+    tok = BPETokenizer.load(args.tokenizer_path)
+    params, meta = ckpt.restore_checkpoint(args.model_path)
+    model = Qwen3(Qwen3Config.from_dict(meta["config"]))
+    print(f"model: {args.model_path} | devices: {jax.devices()}")
+
+    from llm_in_practise_tpu.data.sft import IM_END
+
+    engine_kw = dict(
+        max_slots=args.max_slots, cache_len=args.cache_len,
+        eos_id=tok.token_to_id(IM_END), cache_dtype=jnp.float32,
+    )
+    engine = InferenceEngine(model, params, **engine_kw)
+    adapters = {}
+    if args.lora_modules:
+        from llm_in_practise_tpu.serve.adapters import (
+            build_adapter_engines,
+            parse_lora_modules,
+        )
+
+        adapters = build_adapter_engines(
+            model, params, parse_lora_modules(args.lora_modules), **engine_kw
+        )
+        print(f"adapters: {sorted(adapters)}")
+    server = OpenAIServer(engine, tok, model_name=args.model_name,
+                          adapters=adapters)
+    print(f"serving on {args.host}:{args.port} "
+          f"(/v1/chat/completions, /v1/models, /health, /metrics)")
+    server.serve(host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -294,6 +294,11 @@ class BPETokenizer:
         return len(self.vocab)
 
     @property
+    def vocab_size(self) -> int:
+        """Alias matching :class:`CharTokenizer`'s interface."""
+        return len(self.vocab)
+
+    @property
     def pad_id(self) -> int:
         return self.vocab.get("[PAD]", 0)
 

@@ -45,6 +45,14 @@ def prepare_data(
         return [ln for ln in lines if ln.strip()]
     if dataset.startswith("wikitext"):
         try:
+            import os
+            import socket
+
+            try:  # fail fast offline instead of 5x8s hub retries
+                socket.getaddrinfo("huggingface.co", 443)
+            except OSError:
+                os.environ.setdefault("HF_HUB_OFFLINE", "1")
+                os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
             import datasets as hf_datasets
 
             name = "wikitext-103-raw-v1" if "103" in dataset else "wikitext-2-raw-v1"

@@ -250,7 +250,7 @@ def fsdp(devices: int = -1, data: int = 1) -> Strategy:
     )
 
 
-def tensor_parallel(model: int, data: int = -1) -> Strategy:
+def tensor_parallel(model: int = 2, data: int = -1) -> Strategy:
     """Megatron-style TP over the ``model`` axis (+DP over the rest). The
     reference only reaches TP at inference via vLLM
     (``qwen3_app_autoscaling.yaml:22``); here it is a training strategy too."""
@@ -259,7 +259,7 @@ def tensor_parallel(model: int, data: int = -1) -> Strategy:
     )
 
 
-def fsdp_tp(fsdp_size: int, model: int, data: int = 1) -> Strategy:
+def fsdp_tp(fsdp_size: int = 2, model: int = 2, data: int = 1) -> Strategy:
     """2D sharding: FSDP × TP (the v5e-16 north-star layout)."""
     return Strategy(
         "fsdp_tp",
@@ -268,7 +268,7 @@ def fsdp_tp(fsdp_size: int, model: int, data: int = 1) -> Strategy:
     )
 
 
-def expert_parallel(expert: int, fsdp_size: int = 1, data: int = -1) -> Strategy:
+def expert_parallel(expert: int = 2, fsdp_size: int = 1, data: int = -1) -> Strategy:
     """MoE expert sharding over the ``expert`` axis — beyond the reference
     (described but absent: ``DeepSpeed/README.md:17-18``)."""
     return Strategy(
@@ -290,7 +290,7 @@ def zero_offload(devices: int = -1) -> Strategy:
     )
 
 
-def sequence_parallel(seq: int, fsdp_size: int = 1, data: int = -1) -> Strategy:
+def sequence_parallel(seq: int = 2, fsdp_size: int = 1, data: int = -1) -> Strategy:
     """Sequence/context parallelism over the ``seq`` axis via ring attention —
     beyond the reference (absent there, SURVEY §5.7). Activations are sharded
     ``(batch over data×fsdp, sequence over seq)``; models must set

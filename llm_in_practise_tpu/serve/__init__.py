@@ -15,3 +15,20 @@ from llm_in_practise_tpu.serve.engine import (  # noqa: F401
     SamplingParams,
 )
 from llm_in_practise_tpu.serve.api import OpenAIServer, build_prompt  # noqa: F401
+from llm_in_practise_tpu.serve.adapters import (  # noqa: F401
+    build_adapter_engines,
+    load_adapter,
+    parse_lora_modules,
+)
+from llm_in_practise_tpu.serve.gateway import (  # noqa: F401
+    Gateway,
+    ResponseCache,
+    RetryPolicy,
+    Router,
+    Upstream,
+)
+from llm_in_practise_tpu.serve.moderation import (  # noqa: F401
+    ModerationService,
+    gateway_hook,
+    rule_classifier,
+)

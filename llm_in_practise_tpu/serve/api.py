@@ -23,15 +23,17 @@ throughput is owned by the engine's single background loop.
 
 from __future__ import annotations
 
+import html
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 
 import numpy as np
 
 from llm_in_practise_tpu.data.sft import IM_START, render_chatml
 from llm_in_practise_tpu.serve import schemas
 from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+from llm_in_practise_tpu.serve.http_util import JsonHandler
 
 
 def build_prompt(messages) -> str:
@@ -56,12 +58,22 @@ class OpenAIServer:
         *,
         model_name: str = "llm-in-practise-tpu",
         prompt_builder=build_prompt,
+        adapters: dict[str, InferenceEngine] | None = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.prompt_builder = prompt_builder
+        # vLLM ``--enable-lora --lora-modules name=path`` parity: additional
+        # model names served from adapter-merged weights, picked by the
+        # request's ``model`` field (see serve/adapters.py).
+        self.adapters = dict(adapters or {})
         self._httpd: ThreadingHTTPServer | None = None
+
+    def engine_for(self, model: str | None) -> InferenceEngine | None:
+        if model in (None, "", self.model_name):
+            return self.engine
+        return self.adapters.get(model)
 
     # --- request handling ----------------------------------------------------
 
@@ -71,6 +83,13 @@ class OpenAIServer:
         except schemas.ValidationError as e:
             return send_json(422, {"error": {"message": str(e), "type": "invalid_request_error"}})
 
+        engine = self.engine_for(req.model)
+        if engine is None:
+            return send_json(404, {"error": {
+                "message": f"model {req.model!r} not found; have "
+                           f"{[self.model_name, *self.adapters]}",
+                "type": "invalid_request_error",
+            }})
         prompt = self.prompt_builder(req.messages)
         prompt_ids = self.tokenizer.encode(prompt)
         params = SamplingParams(
@@ -80,7 +99,7 @@ class OpenAIServer:
             greedy=req.temperature == 0.0,
             max_tokens=req.max_tokens,
         )
-        handle = self.engine.submit(prompt_ids, params)
+        handle = engine.submit(prompt_ids, params)
         req_id = schemas.completion_id()
 
         if req.stream:
@@ -140,23 +159,7 @@ class OpenAIServer:
     def make_handler(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *args):  # quiet; obs handles logging
-                pass
-
-            _responded = False
-
-            def _json(self, status: int, payload: dict):
-                self._responded = True
-                body = json.dumps(payload).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
+        class Handler(JsonHandler):
             def _sse(self, events):
                 self._responded = True
                 self.send_response(200)
@@ -187,29 +190,27 @@ class OpenAIServer:
                     return self._json(200, {
                         "object": "list",
                         "data": [{
-                            "id": server.model_name,
+                            "id": name,
                             "object": "model",
                             "owned_by": "llm-in-practise-tpu",
-                        }],
+                        } for name in (server.model_name, *server.adapters)],
                     })
+                if self.path in ("/", "/chat"):
+                    return self._text(
+                        200, webui_html(server.model_name).encode(),
+                        "text/html; charset=utf-8",
+                    )
                 if self.path == "/metrics":
-                    body = server.metrics_text().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
+                    return self._text(200, server.metrics_text().encode(),
+                                      "text/plain; version=0.0.4")
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):
                 if self.path not in ("/v1/chat/completions",):
                     return self._json(404, {"error": {"message": "not found"}})
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(length) or b"{}")
-                except (ValueError, json.JSONDecodeError):
-                    return self._json(400, {"error": {"message": "invalid JSON body"}})
+                body, err = self._read_json()
+                if err:
+                    return self._json(400, err)
                 try:
                     return server.handle_chat(body, self._json, self._sse)
                 except Exception as e:  # noqa: BLE001 — a handler fault must
@@ -228,8 +229,9 @@ class OpenAIServer:
 
     def serve(self, host: str = "0.0.0.0", port: int = 8000, *, background: bool = False):
         """Start engine loop + HTTP server. Returns the bound port."""
-        if self.engine._thread is None:
-            self.engine.start()
+        for eng in (self.engine, *self.adapters.values()):
+            if eng._thread is None:
+                eng.start()
         self._httpd = ThreadingHTTPServer((host, port), self.make_handler())
         bound = self._httpd.server_address[1]
         if background:
@@ -243,3 +245,63 @@ class OpenAIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
         self.engine.stop()
+        for eng in self.adapters.values():
+            eng.stop()
+
+
+def webui_html(model_name: str) -> str:
+    """Minimal streaming chat page — the reference's Gradio web UIs
+    (``Scripts/inference/05-…-webui-infr.py``, streaming ``06-…:52-75``)
+    without the Gradio dependency: vanilla HTML + fetch over the SSE
+    endpoint, incremental delta rendering, multi-turn history."""
+    name_html = html.escape(model_name)
+    name_js = json.dumps(model_name)  # JS string literal, quotes included
+    return """<!doctype html>
+<meta charset="utf-8"><title>chat — """ + name_html + """</title>
+<style>
+ body{font-family:system-ui,sans-serif;max-width:720px;margin:2rem auto;padding:0 1rem}
+ #log{border:1px solid #ccc;border-radius:8px;padding:1rem;min-height:300px;
+      white-space:pre-wrap}
+ .u{color:#036;font-weight:600}.a{color:#222}
+ form{display:flex;gap:.5rem;margin-top:1rem}
+ input{flex:1;padding:.5rem;font-size:1rem}
+ button{padding:.5rem 1rem}
+</style>
+<h2>""" + name_html + """</h2>
+<div id=log></div>
+<form id=f><input id=q autocomplete=off placeholder="message…">
+<button>send</button></form>
+<script>
+const log=document.getElementById('log'),f=document.getElementById('f'),
+      q=document.getElementById('q'),history=[];
+f.onsubmit=async e=>{
+  e.preventDefault();
+  const text=q.value.trim(); if(!text)return; q.value='';
+  history.push({role:'user',content:text});
+  log.append(Object.assign(document.createElement('div'),
+    {className:'u',textContent:'you: '+text}));
+  const out=Object.assign(document.createElement('div'),
+    {className:'a',textContent:'bot: '});
+  log.append(out);
+  const r=await fetch('/v1/chat/completions',{method:'POST',
+    headers:{'Content-Type':'application/json'},
+    body:JSON.stringify({model:""" + name_js + """,messages:history,
+                         stream:true,max_tokens:256})});
+  const reader=r.body.getReader(),dec=new TextDecoder();
+  let buf='',answer='';
+  for(;;){
+    const {done,value}=await reader.read(); if(done)break;
+    buf+=dec.decode(value,{stream:true});
+    let i;
+    while((i=buf.indexOf('\\n\\n'))>=0){
+      const line=buf.slice(0,i).trim(); buf=buf.slice(i+2);
+      if(!line.startsWith('data:'))continue;
+      const data=line.slice(5).trim();
+      if(data==='[DONE]')continue;
+      const delta=JSON.parse(data).choices?.[0]?.delta?.content;
+      if(delta){answer+=delta;out.textContent='bot: '+answer;}
+    }
+  }
+  history.push({role:'assistant',content:answer});
+};
+</script>"""

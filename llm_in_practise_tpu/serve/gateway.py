@@ -1,0 +1,509 @@
+"""Gateway: routing, retries, fallbacks, caching — the LiteLLM-proxy analog.
+
+The reference fronts its model servers with a LiteLLM proxy
+(``Deployment/litellm-proxy/config/litellm-config-router-lb.yaml``):
+cost/load-based routing over a model list, per-error-class retry policy,
+``allowed_fails`` + ``cooldown_time`` circuit breaking, fallback model
+chains, context-window fallbacks, Redis exact/semantic response caches, and
+a pre-call guard-model hook (``litellm-config-guard.yaml`` +
+``llama-guard-wrapper/app.py``). This module is that control plane as one
+stdlib-only HTTP proxy in front of any OpenAI-compatible upstreams (ours or
+vLLM's):
+
+- :class:`Upstream` — one backend (base_url, model, weight, health state).
+- :class:`Router` — picks an upstream for a model group: weighted
+  least-pending with cooldown exclusion.
+- :class:`RetryPolicy` — retries per error class
+  (``retry_policy:`` in the reference yaml).
+- :class:`ResponseCache` — TTL'd exact cache keyed on (model, messages,
+  params); the semantic tier matches by cosine over hashed bag-of-token
+  embeddings (the reference's Redis semantic cache, without the external
+  embedding service).
+- :class:`Gateway` — the HTTP server wiring it together, with
+  ``/v1/chat/completions``, ``/health``, ``/metrics`` and an optional
+  pre-call moderation hook.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import ThreadingHTTPServer
+
+from llm_in_practise_tpu.serve.http_util import JsonHandler
+
+
+@dataclass
+class Upstream:
+    """One backend endpoint for a served model."""
+
+    base_url: str                  # e.g. http://127.0.0.1:8000
+    model: str                     # model name at the upstream
+    group: str                     # public model name this serves
+    weight: float = 1.0            # cost-based routing weight (higher = prefer)
+    allowed_fails: int = 3         # consecutive fails before cooldown
+    cooldown_time: float = 30.0    # seconds out of rotation
+
+    fails: int = 0
+    cooldown_until: float = 0.0
+    pending: int = 0
+    served: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def available(self, now: float) -> bool:
+        return now >= self.cooldown_until
+
+    def record_success(self):
+        with self.lock:
+            self.fails = 0
+
+    def record_failure(self, now: float):
+        with self.lock:
+            self.fails += 1
+            if self.fails >= self.allowed_fails:
+                self.cooldown_until = now + self.cooldown_time
+                self.fails = 0
+
+
+class RouterError(Exception):
+    pass
+
+
+class Router:
+    """Pick an upstream per model group: weighted least-pending among
+    non-cooled-down backends (the yaml's ``routing_strategy:
+    cost-based-routing`` + ``cooldown_time`` semantics)."""
+
+    def __init__(self, upstreams: list[Upstream]):
+        self.upstreams = list(upstreams)
+
+    def groups(self) -> list[str]:
+        return sorted({u.group for u in self.upstreams})
+
+    def candidates(self, group: str) -> list[Upstream]:
+        now = time.time()
+        return [u for u in self.upstreams
+                if u.group == group and u.available(now)]
+
+    def pick(self, group: str, exclude: set[int] = frozenset()) -> Upstream:
+        cands = [u for u in self.candidates(group) if id(u) not in exclude]
+        if not cands:
+            raise RouterError(f"no available upstream for {group!r}")
+        # least in-flight per unit weight; ties broken by total served so
+        # sequential traffic round-robins instead of pinning the first entry
+        return min(cands, key=lambda u: (
+            (u.pending + 1) / max(u.weight, 1e-9),
+            u.served / max(u.weight, 1e-9),
+        ))
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Per-error-class retry counts (reference ``retry_policy:`` block)."""
+
+    timeout_retries: int = 2
+    rate_limit_retries: int = 2      # 429
+    server_error_retries: int = 1    # 5xx
+    bad_request_retries: int = 0     # 4xx (not worth retrying)
+    backoff_s: float = 0.2           # base of exponential backoff
+
+    def retries_for(self, status: int | None) -> int:
+        if status is None:
+            return self.timeout_retries
+        if status == 429:
+            return self.rate_limit_retries
+        if status >= 500:
+            return self.server_error_retries
+        return self.bad_request_retries
+
+
+def _token_embed(text: str, dim: int = 256) -> list[float]:
+    """Hashed bag-of-words embedding — stands in for the reference's
+    embedding service in its semantic cache (README.md:2845-3488); cosine
+    over these catches near-identical rephrasings, and the hook is the
+    boundary where a real encoder plugs in."""
+    vec = [0.0] * dim
+    for word in text.lower().split():
+        h = int.from_bytes(hashlib.md5(word.encode()).digest()[:4], "little")
+        vec[h % dim] += 1.0
+    n = math.sqrt(sum(v * v for v in vec)) or 1.0
+    return [v / n for v in vec]
+
+
+class ResponseCache:
+    """Exact + semantic response cache (the compose stack's dual-namespace
+    Redis cache, in-process). Exact: TTL'd dict on a canonical request key.
+    Semantic: cosine over hashed-BoW embeddings of the last user message."""
+
+    def __init__(self, *, ttl_s: float = 300.0, max_entries: int = 1024,
+                 semantic_threshold: float | None = 0.97):
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self.semantic_threshold = semantic_threshold
+        self._exact: dict[str, tuple[float, dict]] = {}
+        self._semantic: list[tuple[float, str, list[float], dict]] = []
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.semantic_hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(body: dict) -> str:
+        # Whole request (minus transport fields) — two requests differing in
+        # ANY sampling param must not share a cache entry.
+        canon = json.dumps(
+            {k: v for k, v in body.items() if k != "stream"}, sort_keys=True,
+        )
+        return hashlib.sha256(canon.encode()).hexdigest()
+
+    @staticmethod
+    def _conversation_text(body: dict) -> str:
+        """Full conversation (system + every turn): the semantic key must
+        see history, or two chats both ending in 'yes' would collide."""
+        return "\n".join(
+            f"{m.get('role', '')}: {m.get('content', '')}"
+            for m in body.get("messages", [])
+        )
+
+    def get(self, body: dict) -> dict | None:
+        if body.get("stream"):
+            return None
+        now = time.time()
+        key = self._key(body)
+        with self._lock:
+            hit = self._exact.get(key)
+            if hit and now - hit[0] < self.ttl_s:
+                self.hits += 1
+                return hit[1]
+            if self.semantic_threshold is not None:
+                query = _token_embed(self._conversation_text(body))
+                model = body.get("model")
+                best, best_sim = None, 0.0
+                for ts, m, emb, resp in self._semantic:
+                    if m != model or now - ts >= self.ttl_s:
+                        continue
+                    sim = sum(a * b for a, b in zip(query, emb))
+                    if sim > best_sim:
+                        best, best_sim = resp, sim
+                if best is not None and best_sim >= self.semantic_threshold:
+                    self.semantic_hits += 1
+                    return best
+            self.misses += 1
+            return None
+
+    def put(self, body: dict, response: dict) -> None:
+        if body.get("stream"):
+            return
+        now = time.time()
+        with self._lock:
+            self._exact[self._key(body)] = (now, response)
+            if len(self._exact) > self.max_entries:
+                oldest = min(self._exact, key=lambda k: self._exact[k][0])
+                del self._exact[oldest]
+            if self.semantic_threshold is not None:
+                self._semantic.append(
+                    (now, body.get("model"),
+                     _token_embed(self._conversation_text(body)), response)
+                )
+                if len(self._semantic) > self.max_entries:
+                    self._semantic.pop(0)
+
+
+class Gateway:
+    """OpenAI-compatible routing proxy.
+
+    ``moderation`` (optional): callable ``(text) -> (flagged, categories)``
+    run on user content before forwarding — the reference's guard-model
+    pre-call hook; flagged requests get a 400 with the category list
+    (LiteLLM's behavior when the guard trips).
+    """
+
+    def __init__(
+        self,
+        router: Router,
+        *,
+        retry_policy: RetryPolicy = RetryPolicy(),
+        cache: ResponseCache | None = None,
+        fallbacks: dict[str, list[str]] | None = None,
+        context_window_fallbacks: dict[str, list[str]] | None = None,
+        max_context_tokens: dict[str, int] | None = None,
+        moderation=None,
+        timeout_s: float = 120.0,
+        health_check_interval_s: float = 30.0,
+    ):
+        self.router = router
+        self.retry_policy = retry_policy
+        self.cache = cache
+        self.fallbacks = fallbacks or {}
+        self.context_window_fallbacks = context_window_fallbacks or {}
+        self.max_context_tokens = max_context_tokens or {}
+        self.moderation = moderation
+        self.timeout_s = timeout_s
+        self.health_check_interval_s = health_check_interval_s
+        self.requests_total = 0
+        self.failures_total = 0
+        self.fallbacks_total = 0
+        self._httpd: ThreadingHTTPServer | None = None
+        self._health_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # --- upstream I/O --------------------------------------------------------
+
+    def _forward(self, upstream: Upstream, body: dict,
+                 stream: bool = False) -> tuple[int, object]:
+        """POST to one upstream. Non-stream: (status, parsed-JSON dict).
+        Stream success: (200, open http response) — the caller relays the
+        SSE bytes and closes it; ``pending`` then only tracks connection
+        setup, not stream lifetime."""
+        payload = dict(body, model=upstream.model)
+        req = urllib.request.Request(
+            f"{upstream.base_url}/v1/chat/completions",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with upstream.lock:
+            upstream.pending += 1
+            upstream.served += 1
+        try:
+            if stream:
+                r = urllib.request.urlopen(req, timeout=self.timeout_s)
+                return r.status, r
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read())
+            except Exception:
+                detail = {"error": {"message": str(e)}}
+            return e.code, detail
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            return 0, {"error": {"message": f"upstream unreachable: {e}"}}
+        finally:
+            with upstream.lock:
+                upstream.pending -= 1
+
+    def _estimate_tokens(self, body: dict) -> int:
+        chars = sum(len(str(m.get("content", "")))
+                    for m in body.get("messages", []))
+        return chars // 4 + int(body.get("max_tokens", 0) or 0)
+
+    def _chain(self, group: str) -> list[str]:
+        """Model group + its fallback chain, context-window-aware."""
+        chain = [group]
+        chain += [g for g in self.fallbacks.get(group, []) if g not in chain]
+        return chain
+
+    def handle_completion(self, body: dict,
+                          stream: bool = False) -> tuple[int, object]:
+        """Route one completion. ``stream=True`` returns ``(200, open http
+        response)`` on success (relay its bytes); errors are (status, dict)
+        either way. The cache only serves non-stream requests."""
+        self.requests_total += 1
+        group = body.get("model") or (self.router.groups() or ["default"])[0]
+
+        if self.moderation is not None:
+            for msg in body.get("messages", []):
+                if msg.get("role") != "user":
+                    continue
+                flagged, categories = self.moderation(str(msg.get("content", "")))
+                if flagged:
+                    return 400, {"error": {
+                        "message": "request blocked by content moderation",
+                        "type": "moderation_blocked",
+                        "categories": categories,
+                    }}
+
+        if self.cache is not None and not stream:
+            cached = self.cache.get(body)
+            if cached is not None:
+                resp = dict(cached)
+                resp["cached"] = True
+                return 200, resp
+
+        # context-window fallback: if the estimate exceeds the group's
+        # window, skip straight to the larger-context chain
+        chain = self._chain(group)
+        limit = self.max_context_tokens.get(group)
+        if limit and self._estimate_tokens(body) > limit:
+            cw = [g for g in self.context_window_fallbacks.get(group, [])]
+            if cw:
+                chain = cw + [g for g in chain if g not in cw]
+                self.fallbacks_total += 1
+
+        last_status, last_detail = 502, {"error": {"message": "no upstream"}}
+        for gi, g in enumerate(chain):
+            if gi > 0:
+                self.fallbacks_total += 1
+            tried: set[int] = set()
+            retriable = True
+            while True:
+                try:
+                    upstream = self.router.pick(g, exclude=tried)
+                except RouterError:
+                    break
+                tried.add(id(upstream))
+                attempts = 0
+                while True:
+                    status, resp = self._forward(upstream, body, stream=stream)
+                    if status == 200:
+                        upstream.record_success()
+                        if stream:
+                            return 200, resp  # open response; caller relays
+                        resp["model"] = g
+                        if self.cache is not None:
+                            self.cache.put(body, resp)
+                        return 200, resp
+                    retriable = status in (0, 429) or status >= 500
+                    if retriable:
+                        upstream.record_failure(time.time())
+                        self.failures_total += 1
+                    last_status, last_detail = (status or 502), resp
+                    max_r = self.retry_policy.retries_for(
+                        None if status == 0 else status)
+                    if not retriable or attempts >= max_r:
+                        break
+                    time.sleep(self.retry_policy.backoff_s * 2 ** attempts)
+                    attempts += 1
+                if not retriable:
+                    # a 4xx from one upstream will 4xx everywhere; stop
+                    return last_status, last_detail
+        return last_status, last_detail
+
+    # --- health checks -------------------------------------------------------
+
+    def _health_loop(self):
+        while not self._stop.wait(self.health_check_interval_s):
+            for u in self.router.upstreams:
+                try:
+                    with urllib.request.urlopen(
+                        f"{u.base_url}/health", timeout=5
+                    ) as r:
+                        ok = r.status == 200
+                except OSError:
+                    ok = False
+                if ok:
+                    # Reset the consecutive-fail count but DON'T clear an
+                    # active cooldown: an upstream can pass /health while
+                    # 429/500-ing completions, and clearing here would cap
+                    # every cooldown at one health interval.
+                    u.record_success()
+                else:
+                    u.record_failure(time.time())
+
+    # --- HTTP ----------------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        lines = [
+            "# TYPE gateway_requests_total counter",
+            f"gateway_requests_total {self.requests_total}",
+            "# TYPE gateway_upstream_failures_total counter",
+            f"gateway_upstream_failures_total {self.failures_total}",
+            "# TYPE gateway_fallbacks_total counter",
+            f"gateway_fallbacks_total {self.fallbacks_total}",
+        ]
+        if self.cache is not None:
+            lines += [
+                "# TYPE gateway_cache_hits_total counter",
+                f"gateway_cache_hits_total {self.cache.hits}",
+                "# TYPE gateway_cache_semantic_hits_total counter",
+                f"gateway_cache_semantic_hits_total {self.cache.semantic_hits}",
+                "# TYPE gateway_cache_misses_total counter",
+                f"gateway_cache_misses_total {self.cache.misses}",
+            ]
+        now = time.time()
+        for u in self.router.upstreams:
+            label = f'{{group="{u.group}",url="{u.base_url}"}}'
+            lines += [
+                f"gateway_upstream_pending{label} {u.pending}",
+                f"gateway_upstream_available{label} {int(u.available(now))}",
+            ]
+        return "\n".join(lines) + "\n"
+
+    def make_handler(self):
+        gw = self
+
+        class Handler(JsonHandler):
+            def do_GET(self):
+                if self.path == "/health":
+                    return self._json(200, {"status": "ok"})
+                if self.path == "/v1/models":
+                    return self._json(200, {
+                        "object": "list",
+                        "data": [{"id": g, "object": "model"}
+                                 for g in gw.router.groups()],
+                    })
+                if self.path == "/metrics":
+                    return self._text(200, gw.metrics_text().encode(),
+                                      "text/plain; version=0.0.4")
+                return self._json(404, {"error": {"message": "not found"}})
+
+            def do_POST(self):
+                if self.path != "/v1/chat/completions":
+                    return self._json(404, {"error": {"message": "not found"}})
+                body, err = self._read_json()
+                if err:
+                    return self._json(400, err)
+                stream = bool(body.get("stream"))
+                try:
+                    status, resp = gw.handle_completion(body, stream=stream)
+                    if stream and status == 200 and not isinstance(resp, dict):
+                        return self._relay_sse(resp)
+                except Exception as e:  # noqa: BLE001
+                    if self._responded:
+                        return None
+                    status, resp = 500, {"error": {
+                        "message": f"{type(e).__name__}: {e}"}}
+                return self._json(status, resp)
+
+            def _relay_sse(self, upstream_resp):
+                """Pipe the upstream SSE body through unchanged."""
+                self._responded = True
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    upstream_resp.headers.get("Content-Type",
+                                              "text/event-stream"),
+                )
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    while True:
+                        chunk = upstream_resp.read(4096)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    upstream_resp.close()
+
+        return Handler
+
+    def serve(self, host: str = "0.0.0.0", port: int = 4000, *,
+              background: bool = False) -> int:
+        self._httpd = ThreadingHTTPServer((host, port), self.make_handler())
+        bound = self._httpd.server_address[1]
+        if self.health_check_interval_s > 0:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True)
+            self._health_thread.start()
+        if background:
+            threading.Thread(
+                target=self._httpd.serve_forever, daemon=True).start()
+        else:
+            self._httpd.serve_forever()
+        return bound
+
+    def shutdown(self):
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None

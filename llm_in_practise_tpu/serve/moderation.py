@@ -1,0 +1,163 @@
+"""Content moderation service — the Llama-Guard wrapper analog.
+
+The reference wraps a vLLM-served Llama-Guard-3 behind a FastAPI
+``/v1/moderations`` endpoint translating guard verdicts into the OpenAI
+moderation schema, with an ``X-API-KEY`` middleware
+(``Deployment/litellm-proxy/llama-guard-wrapper/{app.py:22-66,
+model_client.py, openai_moderation_map.py, schemas.py}``). Here:
+
+- the category taxonomy and OpenAI-schema mapping are ported behavior
+  (S1..S13 hazard codes → ``hate``/``violence``/… flags),
+- the *classifier* is pluggable: default is a transparent keyword/rule
+  scorer (runs anywhere, no model download); pass ``classifier=`` any
+  callable ``text -> list[str]`` of hazard codes — e.g. one that prompts a
+  guard LLM served by :mod:`llm_in_practise_tpu.serve.api` the way the
+  reference prompts Llama-Guard through vLLM,
+- :func:`gateway_hook` adapts a service into the Gateway's pre-call check.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+from http.server import ThreadingHTTPServer
+
+from llm_in_practise_tpu.serve.http_util import JsonHandler
+
+# Llama-Guard-3 hazard taxonomy → OpenAI moderation categories
+# (openai_moderation_map.py behavior).
+HAZARD_TO_OPENAI = {
+    "S1": "violence",                 # violent crimes
+    "S2": "illicit",                  # non-violent crimes
+    "S3": "sexual",                   # sex crimes
+    "S4": "sexual/minors",
+    "S5": "harassment",               # defamation
+    "S6": "illicit",                  # specialized advice
+    "S7": "privacy",
+    "S8": "illicit",                  # intellectual property
+    "S9": "illicit/violent",          # indiscriminate weapons
+    "S10": "hate",
+    "S11": "self-harm",
+    "S12": "sexual",                  # adult content
+    "S13": "illicit",                 # elections
+}
+
+OPENAI_CATEGORIES = sorted(set(HAZARD_TO_OPENAI.values()))
+
+_DEFAULT_RULES: dict[str, tuple[str, ...]] = {
+    "S1": ("kill", "murder", "attack someone", "hurt someone"),
+    "S7": ("social security number", "home address of", "dox"),
+    "S9": ("build a bomb", "make a weapon", "explosive device"),
+    "S10": ("hate speech",),
+    "S11": ("kill myself", "suicide", "self-harm", "hurt myself"),
+}
+
+
+def rule_classifier(rules: dict[str, tuple[str, ...]] | None = None):
+    """Keyword classifier: ``text -> [hazard codes]``. The default stand-in
+    for the guard model; deliberately conservative and transparent."""
+    rules = rules or _DEFAULT_RULES
+    compiled = {
+        code: re.compile("|".join(re.escape(p) for p in pats), re.IGNORECASE)
+        for code, pats in rules.items()
+    }
+
+    def classify(text: str) -> list[str]:
+        return [code for code, rx in compiled.items() if rx.search(text)]
+
+    return classify
+
+
+@dataclass
+class ModerationService:
+    """``/v1/moderations`` with the OpenAI response schema."""
+
+    classifier: object = field(default_factory=rule_classifier)
+    api_key: str | None = None     # X-API-KEY middleware parity
+    model_name: str = "guard-rules"
+    requests_total: int = 0
+    flagged_total: int = 0
+    _httpd: ThreadingHTTPServer | None = None
+
+    def moderate(self, text: str) -> dict:
+        """One input → OpenAI moderation result dict."""
+        self.requests_total += 1
+        hazards = list(self.classifier(text))
+        categories = {c: False for c in OPENAI_CATEGORIES}
+        scores = {c: 0.0 for c in OPENAI_CATEGORIES}
+        for code in hazards:
+            cat = HAZARD_TO_OPENAI.get(code)
+            if cat:
+                categories[cat] = True
+                scores[cat] = 1.0
+        flagged = any(categories.values())
+        if flagged:
+            self.flagged_total += 1
+        return {
+            "flagged": flagged,
+            "categories": categories,
+            "category_scores": scores,
+        }
+
+    def handle(self, body: dict) -> tuple[int, dict]:
+        raw = body.get("input", "")
+        inputs = raw if isinstance(raw, list) else [raw]
+        results = [self.moderate(str(t)) for t in inputs]
+        return 200, {
+            "id": "modr-llm-in-practise-tpu",
+            "model": body.get("model", self.model_name),
+            "results": results,
+        }
+
+    # --- HTTP ----------------------------------------------------------------
+
+    def make_handler(self):
+        svc = self
+
+        class Handler(JsonHandler):
+            def do_GET(self):
+                if self.path == "/health":
+                    return self._json(200, {"status": "ok"})
+                return self._json(404, {"error": {"message": "not found"}})
+
+            def do_POST(self):
+                if svc.api_key and self.headers.get("X-API-KEY") != svc.api_key:
+                    return self._json(401, {"error": {"message": "invalid API key"}})
+                if self.path != "/v1/moderations":
+                    return self._json(404, {"error": {"message": "not found"}})
+                body, err = self._read_json()
+                if err:
+                    return self._json(400, err)
+                status, resp = svc.handle(body)
+                return self._json(status, resp)
+
+        return Handler
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8001, *,
+              background: bool = False) -> int:
+        self._httpd = ThreadingHTTPServer((host, port), self.make_handler())
+        bound = self._httpd.server_address[1]
+        if background:
+            threading.Thread(
+                target=self._httpd.serve_forever, daemon=True).start()
+        else:
+            self._httpd.serve_forever()
+        return bound
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+
+def gateway_hook(service: ModerationService):
+    """Adapt a ModerationService into the Gateway's pre-call moderation
+    callable ``text -> (flagged, [categories])``."""
+
+    def hook(text: str):
+        result = service.moderate(text)
+        cats = [c for c, v in result["categories"].items() if v]
+        return result["flagged"], cats
+
+    return hook

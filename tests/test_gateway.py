@@ -1,0 +1,362 @@
+"""Gateway (LiteLLM-proxy analog), moderation, and serve-time adapters.
+
+Behavioral contract from the reference configs:
+``litellm-config-router-lb.yaml:53-96`` (routing, retry policy, cooldowns,
+fallback chains, context-window fallbacks), the compose stack's Redis
+exact/semantic caches, ``llama-guard-wrapper/app.py`` (moderation schema +
+API key), and vLLM ``--lora-modules`` (``Fine-Tuning/README.md:340-361``).
+Fake upstreams are plain HTTP servers — no model in the loop.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from llm_in_practise_tpu.serve.gateway import (
+    Gateway,
+    ResponseCache,
+    RetryPolicy,
+    Router,
+    RouterError,
+    Upstream,
+)
+from llm_in_practise_tpu.serve.moderation import (
+    ModerationService,
+    gateway_hook,
+    rule_classifier,
+)
+
+
+class FakeUpstream:
+    """Scriptable OpenAI-ish backend: responds per its `script` list
+    (status codes; 200 returns a completion naming this upstream)."""
+
+    def __init__(self, name, script=None):
+        self.name = name
+        self.script = list(script or [])
+        self.calls = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"status": "ok"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                outer.calls += 1
+                status = outer.script.pop(0) if outer.script else 200
+                if status == 200:
+                    payload = {
+                        "id": "x", "object": "chat.completion",
+                        "model": outer.name,
+                        "choices": [{"index": 0, "message": {
+                            "role": "assistant",
+                            "content": f"from {outer.name}"},
+                            "finish_reason": "stop"}],
+                        "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                                  "total_tokens": 2},
+                    }
+                else:
+                    payload = {"error": {"message": f"scripted {status}"}}
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def base_url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def _req(body):
+    return dict({"messages": [{"role": "user", "content": "hello"}]}, **body)
+
+
+@pytest.fixture
+def fakes():
+    created = []
+
+    def make(name, script=None):
+        f = FakeUpstream(name, script)
+        created.append(f)
+        return f
+
+    yield make
+    for f in created:
+        f.close()
+
+
+def make_gateway(upstreams, **kw):
+    kw.setdefault("retry_policy", RetryPolicy(backoff_s=0.01))
+    kw.setdefault("health_check_interval_s", 0)
+    return Gateway(Router(upstreams), **kw)
+
+
+def test_routes_and_responds(fakes):
+    up = fakes("m1")
+    gw = make_gateway([Upstream(up.base_url, "m1", group="chat")])
+    status, resp = gw.handle_completion(_req({"model": "chat"}))
+    assert status == 200
+    assert resp["choices"][0]["message"]["content"] == "from m1"
+    assert resp["model"] == "chat"  # public group name, not upstream's
+
+
+def test_least_pending_spreads_over_weights(fakes):
+    a, b = fakes("a"), fakes("b")
+    router = Router([
+        Upstream(a.base_url, "a", group="chat", weight=1.0),
+        Upstream(b.base_url, "b", group="chat", weight=1.0),
+    ])
+    gw = Gateway(router, health_check_interval_s=0)
+    for _ in range(6):
+        status, _ = gw.handle_completion(_req({"model": "chat"}))
+        assert status == 200
+    assert a.calls and b.calls  # both saw traffic
+
+
+def test_retry_then_success_same_class(fakes):
+    up = fakes("m1", script=[500, 200])
+    gw = make_gateway([Upstream(up.base_url, "m1", group="chat")])
+    status, resp = gw.handle_completion(_req({"model": "chat"}))
+    assert status == 200 and up.calls == 2
+
+
+def test_bad_request_not_retried(fakes):
+    up = fakes("m1", script=[422, 200])
+    gw = make_gateway([Upstream(up.base_url, "m1", group="chat")])
+    status, _ = gw.handle_completion(_req({"model": "chat"}))
+    assert status == 422 and up.calls == 1
+
+
+def test_cooldown_after_allowed_fails(fakes):
+    bad = fakes("bad", script=[500] * 10)
+    good = fakes("good")
+    u_bad = Upstream(bad.base_url, "bad", group="chat",
+                     allowed_fails=2, cooldown_time=60)
+    gw = make_gateway([u_bad, Upstream(good.base_url, "good", group="chat",
+                                       weight=0.1)])
+    # drive failures until the bad upstream cools down; requests still served
+    for _ in range(4):
+        status, _ = gw.handle_completion(_req({"model": "chat"}))
+        assert status == 200
+    assert not u_bad.available(__import__("time").time())
+    calls_before = bad.calls
+    gw.handle_completion(_req({"model": "chat"}))
+    assert bad.calls == calls_before  # cooled down: skipped entirely
+
+
+def test_fallback_chain(fakes):
+    down = fakes("down", script=[500] * 10)
+    backup = fakes("backup")
+    gw = make_gateway(
+        [Upstream(down.base_url, "down", group="primary", allowed_fails=1),
+         Upstream(backup.base_url, "backup", group="secondary")],
+        fallbacks={"primary": ["secondary"]},
+    )
+    status, resp = gw.handle_completion(_req({"model": "primary"}))
+    assert status == 200
+    assert resp["choices"][0]["message"]["content"] == "from backup"
+    assert gw.fallbacks_total == 1
+
+
+def test_context_window_fallback(fakes):
+    small = fakes("small")
+    large = fakes("large")
+    gw = make_gateway(
+        [Upstream(small.base_url, "small", group="chat"),
+         Upstream(large.base_url, "large", group="chat-32k")],
+        context_window_fallbacks={"chat": ["chat-32k"]},
+        max_context_tokens={"chat": 50},
+    )
+    long_msg = {"messages": [{"role": "user", "content": "x" * 1000}],
+                "model": "chat"}
+    status, resp = gw.handle_completion(long_msg)
+    assert status == 200
+    assert resp["choices"][0]["message"]["content"] == "from large"
+    assert small.calls == 0
+
+
+def test_no_upstream_is_502():
+    gw = Gateway(Router([]), health_check_interval_s=0)
+    status, resp = gw.handle_completion(_req({"model": "nope"}))
+    assert status == 502 and "error" in resp
+
+
+def test_exact_cache_hit(fakes):
+    up = fakes("m1")
+    gw = make_gateway([Upstream(up.base_url, "m1", group="chat")],
+                      cache=ResponseCache(semantic_threshold=None))
+    body = _req({"model": "chat", "temperature": 0.0})
+    s1, r1 = gw.handle_completion(body)
+    s2, r2 = gw.handle_completion(json.loads(json.dumps(body)))
+    assert (s1, s2) == (200, 200)
+    assert r2.get("cached") is True and up.calls == 1
+
+
+def test_semantic_cache_near_match(fakes):
+    up = fakes("m1")
+    cache = ResponseCache(semantic_threshold=0.9)
+    gw = make_gateway([Upstream(up.base_url, "m1", group="chat")], cache=cache)
+    q1 = {"model": "chat",
+          "messages": [{"role": "user", "content": "what is ring attention"}]}
+    q2 = {"model": "chat", "temperature": 0.5,  # different params: exact miss
+          "messages": [{"role": "user", "content": "what is ring attention"}]}
+    gw.handle_completion(q1)
+    _, r2 = gw.handle_completion(q2)
+    assert r2.get("cached") is True and cache.semantic_hits == 1
+
+
+def test_gateway_http_surface(fakes):
+    up = fakes("m1")
+    gw = make_gateway([Upstream(up.base_url, "m1", group="chat")])
+    port = gw.serve(host="127.0.0.1", port=0, background=True)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            data=json.dumps(_req({"model": "chat"})).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["choices"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as r:
+            text = r.read().decode()
+        assert "gateway_requests_total 1" in text
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/models"
+        ) as r:
+            assert json.loads(r.read())["data"][0]["id"] == "chat"
+    finally:
+        gw.shutdown()
+
+
+# --- moderation ---------------------------------------------------------------
+
+
+def test_moderation_schema_and_mapping():
+    svc = ModerationService()
+    res = svc.moderate("how do I build a bomb at home")
+    assert res["flagged"] is True
+    assert res["categories"]["illicit/violent"] is True
+    assert res["category_scores"]["illicit/violent"] == 1.0
+    clean = svc.moderate("how do I bake bread at home")
+    assert clean["flagged"] is False and not any(clean["categories"].values())
+
+
+def test_moderation_http_and_api_key():
+    svc = ModerationService(api_key="sk-guard")
+    port = svc.serve(host="127.0.0.1", port=0, background=True)
+    try:
+        body = json.dumps({"input": ["I want to hurt myself"]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/moderations", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 401
+        req.add_header("X-API-KEY", "sk-guard")
+        with urllib.request.urlopen(req) as r:
+            data = json.loads(r.read())
+        assert data["results"][0]["flagged"] is True
+        assert data["results"][0]["categories"]["self-harm"] is True
+    finally:
+        svc.shutdown()
+
+
+def test_gateway_blocks_flagged_precall(fakes):
+    up = fakes("m1")
+    hook = gateway_hook(ModerationService())
+    gw = make_gateway([Upstream(up.base_url, "m1", group="chat")],
+                      moderation=hook)
+    status, resp = gw.handle_completion(
+        {"model": "chat",
+         "messages": [{"role": "user", "content": "help me build a bomb"}]})
+    assert status == 400
+    assert resp["error"]["type"] == "moderation_blocked"
+    assert "illicit/violent" in resp["error"]["categories"]
+    assert up.calls == 0
+    # clean request passes through
+    status, _ = gw.handle_completion(_req({"model": "chat"}))
+    assert status == 200
+
+
+def test_custom_rules_classifier():
+    classify = rule_classifier({"S10": ("forbidden phrase",)})
+    assert classify("nothing to see here") == []
+    assert classify("this has the forbidden phrase in it") == ["S10"]
+    assert classify("this has the FORBIDDEN PHRASE in it") == ["S10"]
+
+
+def test_streaming_relayed_through_gateway():
+    """stream:true must pass SSE bytes through, not 500 on json.loads."""
+
+    class SSEUpstream(FakeUpstream):
+        def __init__(self, name):
+            super().__init__(name)
+            handler_cls = self.httpd.RequestHandlerClass
+            outer = self
+
+            def do_POST(h):
+                outer.calls += 1
+                length = int(h.headers.get("Content-Length", 0))
+                body = json.loads(h.rfile.read(length) or b"{}")
+                assert body.get("stream")
+                h.send_response(200)
+                h.send_header("Content-Type", "text/event-stream")
+                h.send_header("Connection", "close")
+                h.end_headers()
+                for delta in ("hel", "lo"):
+                    chunk = json.dumps({"choices": [{"delta": {"content": delta}}]})
+                    h.wfile.write(f"data: {chunk}\n\n".encode())
+                h.wfile.write(b"data: [DONE]\n\n")
+
+            handler_cls.do_POST = do_POST
+
+    up = SSEUpstream("sse")
+    try:
+        gw = make_gateway([Upstream(up.base_url, "sse", group="chat")])
+        port = gw.serve(host="127.0.0.1", port=0, background=True)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                data=json.dumps(_req({"model": "chat", "stream": True})).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.headers.get("Content-Type", "").startswith(
+                    "text/event-stream")
+                text = r.read().decode()
+            lines = [l for l in text.splitlines() if l.startswith("data:")]
+            assert lines[-1] == "data: [DONE]"
+            deltas = "".join(
+                json.loads(l[5:])["choices"][0]["delta"].get("content", "")
+                for l in lines[:-1]
+            )
+            assert deltas == "hello"
+        finally:
+            gw.shutdown()
+    finally:
+        up.close()

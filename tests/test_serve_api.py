@@ -126,3 +126,68 @@ def test_metrics_exposition(server):
     assert "llm_requests_total" in text
     assert "llm_ttft_seconds" in text
     assert 'quantile="0.99"' in text
+
+
+def test_webui_page(server):
+    status, body = _get(server, "/")
+    assert status == 200
+    text = body.decode()
+    assert "<form" in text and "/v1/chat/completions" in text
+
+
+def test_adapter_routing(tmp_path):
+    """vLLM --lora-modules parity: adapter model names route to merged
+    weights; unknown models 404."""
+    import jax
+
+    from llm_in_practise_tpu.ckpt import checkpoint as ckpt_lib
+    from llm_in_practise_tpu.peft import LoRAConfig, init_lora
+    from llm_in_practise_tpu.serve.adapters import (
+        build_adapter_engines,
+        parse_lora_modules,
+    )
+
+    cfg = GPTConfig(vocab_size=256, seq_len=64, n_layer=1, n_head=2,
+                    embed_dim=32, dropout=0.0, pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    lcfg = LoRAConfig(r=2, alpha=4.0, target_patterns=("attn/q_proj",))
+    lp = init_lora(params, lcfg, jax.random.PRNGKey(1))
+    ckpt_lib.save_named(str(tmp_path), lp, "adapter",
+                        metadata={"lora_config": lcfg.to_dict()})
+
+    modules = parse_lora_modules([f"tuned={tmp_path}"])
+    adapters = build_adapter_engines(
+        model, params, modules, max_slots=1, cache_len=64,
+        cache_dtype=jnp.float32,
+    )
+    engine = InferenceEngine(model, params, max_slots=1, cache_len=64,
+                             cache_dtype=jnp.float32)
+    srv = OpenAIServer(engine, ByteTokenizer(), model_name="base",
+                       adapters=adapters)
+    port = srv.serve(host="127.0.0.1", port=0, background=True)
+    addr = ("127.0.0.1", port)
+    try:
+        status, body = _get(addr, "/v1/models")
+        ids = [m["id"] for m in json.loads(body)["data"]]
+        assert ids == ["base", "tuned"]
+        msg = {"messages": [{"role": "user", "content": "hi"}],
+               "max_tokens": 4, "temperature": 0.0}
+        for name in ("base", "tuned"):
+            status, body = _post(addr, "/v1/chat/completions",
+                                 dict(msg, model=name))
+            assert status == 200, body
+            assert json.loads(body)["usage"]["completion_tokens"] >= 1
+        status, body = _post(addr, "/v1/chat/completions",
+                             dict(msg, model="missing"))
+        assert status == 404
+    finally:
+        srv.shutdown()
+
+
+def test_parse_lora_modules_errors():
+    from llm_in_practise_tpu.serve.adapters import parse_lora_modules
+
+    with pytest.raises(ValueError):
+        parse_lora_modules(["noequals"])
+    assert parse_lora_modules(["a=/p", "b=/q"]) == {"a": "/p", "b": "/q"}
