@@ -1,0 +1,175 @@
+"""Serving load harness: concurrency ladder with TTFT/TPOT/OutputTPS.
+
+TPU-native counterpart of the reference's benchmark layer — the `vllm
+bench serve` ShareGPT ladder with JSON aggregation
+(``LLM_on_Kubernetes/Inference_Platfrom/README.md:1345-1520``, results
+table ``:1504-1512``) and the Locust tokens/s harness
+(``Deployment/Ray/scripts/locustfile-TPS.py``). Drives any
+OpenAI-compatible endpoint (ours or vLLM's) over streaming SSE so TTFT
+(first token) and TPOT (inter-token) are measured where they happen.
+
+Prints one JSON line per concurrency level plus a summary table:
+OutputTPS, p50/p99 TTFT, p50/p99 TPOT, success rate — the reference's
+result schema. SLA check: p99 TTFT < 2s, p99 TPOT < 100ms
+(``README.md:1517``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+import urllib.request
+
+
+PROMPTS = [
+    "Explain how a systolic array multiplies matrices.",
+    "What is ring attention and when is it useful?",
+    "Summarize the difference between data and tensor parallelism.",
+    "Who are you?",
+    "Write a haiku about compilers.",
+    "What does ZeRO stage 3 shard?",
+]
+
+
+def _quantile(xs, q):
+    """Linear-interpolated quantile — a floor index would hide the worst
+    observation and could flip the SLA gate."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def one_request(url, model, prompt, max_tokens, timeout):
+    """Returns (ok, ttft_s, tpot_list, n_tokens)."""
+    body = json.dumps({
+        "model": model,
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "stream": True,
+    }).encode()
+    req = urllib.request.Request(
+        f"{url}/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    ttft = None
+    stamps = []
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            while True:
+                # SSE is newline-delimited; readline blocks exactly until
+                # the next event without per-byte Python overhead
+                line = r.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[5:].strip()
+                if data == b"[DONE]":
+                    continue
+                try:
+                    delta = json.loads(data)["choices"][0].get(
+                        "delta", {}).get("content")
+                except (ValueError, KeyError, IndexError):
+                    continue
+                if delta:
+                    now = time.perf_counter()
+                    if ttft is None:
+                        ttft = now - t0
+                    stamps.append(now)
+    except OSError:
+        return False, None, [], 0
+    tpot = [b - a for a, b in zip(stamps, stamps[1:])]
+    return ttft is not None, ttft, tpot, len(stamps)
+
+
+def run_level(url, model, concurrency, n_requests, max_tokens, timeout):
+    results = []
+    lock = threading.Lock()
+    queue = list(range(n_requests))
+    rng = random.Random(0)
+    prompts = [rng.choice(PROMPTS) for _ in range(n_requests)]
+
+    def worker():
+        while True:
+            with lock:
+                if not queue:
+                    return
+                i = queue.pop()
+            r = one_request(url, model, prompts[i], max_tokens, timeout)
+            with lock:
+                results.append(r)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    oks = [r for r in results if r[0]]
+    ttfts = [r[1] for r in oks]
+    tpots = [x for r in oks for x in r[2]]
+    total_tokens = sum(r[3] for r in oks)
+    return {
+        "concurrency": concurrency,
+        "requests": n_requests,
+        "success_rate": len(oks) / max(n_requests, 1),
+        "output_tps": total_tokens / wall if wall else 0.0,
+        "ttft_p50_ms": _quantile(ttfts, 0.5) * 1e3,
+        "ttft_p99_ms": _quantile(ttfts, 0.99) * 1e3,
+        "tpot_p50_ms": _quantile(tpots, 0.5) * 1e3,
+        "tpot_p99_ms": _quantile(tpots, 0.99) * 1e3,
+        "wall_s": wall,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", default="http://127.0.0.1:8000")
+    p.add_argument("--model", default=None)
+    p.add_argument("--concurrency", default="1,4,8,16",
+                   help="comma-separated ladder")
+    p.add_argument("--requests", type=int, default=32, help="per level")
+    p.add_argument("--max_tokens", type=int, default=64)
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--sla_ttft_ms", type=float, default=2000.0)
+    p.add_argument("--sla_tpot_ms", type=float, default=100.0)
+    args = p.parse_args()
+
+    if args.model is None:
+        with urllib.request.urlopen(f"{args.url}/v1/models", timeout=10) as r:
+            args.model = json.loads(r.read())["data"][0]["id"]
+
+    rows = []
+    for level in (int(c) for c in args.concurrency.split(",")):
+        row = run_level(args.url, args.model, level, args.requests,
+                        args.max_tokens, args.timeout)
+        rows.append(row)
+        print(json.dumps(row))
+
+    print(f"\n{'conc':>5} {'OutTPS':>8} {'p50TTFT':>9} {'p99TTFT':>9} "
+          f"{'p50TPOT':>9} {'p99TPOT':>9} {'ok%':>5}")
+    for r in rows:
+        print(f"{r['concurrency']:>5} {r['output_tps']:>8.1f} "
+              f"{r['ttft_p50_ms']:>8.0f}m {r['ttft_p99_ms']:>8.0f}m "
+              f"{r['tpot_p50_ms']:>8.1f}m {r['tpot_p99_ms']:>8.1f}m "
+              f"{r['success_rate'] * 100:>4.0f}%")
+    worst = rows[-1]
+    ok = (worst["ttft_p99_ms"] < args.sla_ttft_ms
+          and worst["tpot_p99_ms"] < args.sla_tpot_ms)
+    print(f"SLA (p99 TTFT<{args.sla_ttft_ms:.0f}ms, "
+          f"p99 TPOT<{args.sla_tpot_ms:.0f}ms): {'PASS' if ok else 'FAIL'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Dataset format converters.
+
+Counterpart of the reference's
+``Fine-Tuning/LLaMA-Factory/convert_self_cognition_to_alpaca.py``: turn
+self-cognition JSONL records (``query``/``response`` with ``{{NAME}}``/
+``{{AUTHOR}}`` placeholders) into alpaca-format JSON
+(``instruction``/``input``/``output``) with the substitutions applied.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+
+def self_cognition_to_alpaca(
+    records: Iterable[dict], *, name: str, author: str
+) -> list[dict]:
+    from llm_in_practise_tpu.data.sft import substitute_placeholders
+
+    return [
+        {"instruction": str(r.get("query", "")), "input": "",
+         "output": str(r.get("response", ""))}
+        for r in substitute_placeholders(list(records), name, author)
+    ]
+
+
+def convert_file(in_path: str, out_path: str, *, name: str, author: str) -> int:
+    """JSONL in → alpaca JSON out; returns the record count."""
+    records = []
+    with open(in_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    converted = self_cognition_to_alpaca(records, name=name, author=author)
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(converted, f, ensure_ascii=False, indent=2)
+    return len(converted)
+
+
+def alpaca_to_messages(record: dict, system_prompt: str | None = None) -> list[dict]:
+    """One alpaca record → OpenAI-style messages (for the SFT pipeline)."""
+    user = record.get("instruction", "")
+    if record.get("input"):
+        user = f"{user}\n{record['input']}"
+    msgs = []
+    if system_prompt:
+        msgs.append({"role": "system", "content": system_prompt})
+    msgs.append({"role": "user", "content": user})
+    msgs.append({"role": "assistant", "content": record.get("output", "")})
+    return msgs
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("input")
+    p.add_argument("output")
+    p.add_argument("--name", required=True)
+    p.add_argument("--author", required=True)
+    a = p.parse_args()
+    n = convert_file(a.input, a.output, name=a.name, author=a.author)
+    print(f"converted {n} records -> {a.output}")

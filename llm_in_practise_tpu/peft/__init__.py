@@ -12,15 +12,21 @@ from llm_in_practise_tpu.peft.qlora import (
     qlora_apply,
     quantize_base,
 )
+from llm_in_practise_tpu.peft.fused import (
+    make_fused_qlora_loss_fn,
+    qlora_fused_apply,
+)
 
 __all__ = [
     "LoRAConfig",
     "apply_lora",
     "init_lora",
+    "make_fused_qlora_loss_fn",
     "make_qlora_loss_fn",
     "memory_report",
     "merge_lora",
     "qlora_apply",
+    "qlora_fused_apply",
     "quantize_base",
     "target_paths",
     "trainable_report",

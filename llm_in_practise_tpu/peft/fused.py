@@ -1,0 +1,123 @@
+"""Fused QLoRA forward: NF4 base streamed through the Pallas kernel.
+
+:func:`llm_in_practise_tpu.peft.qlora.qlora_apply` dequantizes the whole
+base to bf16 in HBM before the model runs — simple, but it pays 4x the
+weight bandwidth and holds a transient bf16 copy. This module is the fused
+path the reference gets from bitsandbytes' CUDA kernels
+(``qwen3-14b-qlora-dist-deepspeed.py:101-107``): a flax method interceptor
+replaces every quantized ``nn.Dense`` call with
+
+    ``y = nf4_matmul(x, W_nf4) + (x @ A) @ B · (α/r) + bias``
+
+so the packed 4-bit weight goes straight into VMEM
+(:mod:`llm_in_practise_tpu.ops.nf4_matmul`), the LoRA delta runs as two
+rank-r matmuls (never materializing ΔW), and the bf16 base never exists in
+HBM in either the forward or the backward (base frozen — gradient flows to
+``x`` and the LoRA factors only). Non-quantized modules run untouched.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.ops.nf4_matmul import nf4_matmul
+from llm_in_practise_tpu.peft import lora as lora_lib
+from llm_in_practise_tpu.quant.nf4 import NF4Tensor
+from llm_in_practise_tpu.utils.tree import flatten_with_paths
+
+
+def qlora_fused_apply(
+    model,
+    qparams,
+    lora_params,
+    cfg: lora_lib.LoRAConfig,
+    *args,
+    compute_dtype=jnp.bfloat16,
+    **apply_kwargs,
+):
+    """Run ``model.apply`` with quantized Dense kernels served by the fused
+    kernel. ``qparams``: params tree with NF4Tensor kernel leaves (from
+    :func:`..peft.qlora.quantize_base`); ``lora_params``: factor tree from
+    :func:`..peft.lora.init_lora`. Gradients flow through the closure to
+    ``lora_params`` only (the NF4 base is non-differentiable storage)."""
+    quant = {
+        k: v for k, v in flatten_with_paths(
+            qparams, is_leaf=lambda x: isinstance(x, NF4Tensor)
+        ).items()
+        if isinstance(v, NF4Tensor)
+    }
+    consumed: set[str] = set()
+    # init_lora's tree is already keyed by kernel path: {path: {"a", "b"}}
+    lora_by_path: dict[str, dict] = lora_params or {}
+
+    # Dense never reads its kernel when intercepted — swap NF4 leaves for
+    # tiny placeholders so the params tree stays a valid array pytree
+    # without materializing the dequantized weight.
+    placeholders = jax.tree_util.tree_map(
+        lambda v: jnp.zeros((1, 1), compute_dtype)
+        if isinstance(v, NF4Tensor) else v,
+        qparams, is_leaf=lambda v: isinstance(v, NF4Tensor),
+    )
+
+    def lora_delta(key, x):
+        lp = lora_by_path.get(key)
+        if lp is None:
+            return None
+        a = lp["a"].astype(compute_dtype)
+        b = lp["b"].astype(compute_dtype)
+        return (x.astype(compute_dtype) @ a) @ b * cfg.scaling
+
+    def interceptor(next_fn, call_args, call_kwargs, context):
+        mod = context.module
+        if not (isinstance(mod, nn.Dense) and context.method_name == "__call__"):
+            return next_fn(*call_args, **call_kwargs)
+        key = "/".join(mod.path) + "/kernel"
+        t = quant.get(key)
+        x = call_args[0]
+        if t is None:
+            # unquantized Dense: normal path, but a LoRA target must still
+            # get its delta (qlora_apply adapts every target)
+            y = next_fn(*call_args, **call_kwargs)
+            delta = lora_delta(key, x)
+            return y if delta is None else (y + delta).astype(y.dtype)
+        consumed.add(key)
+        y = nf4_matmul(x.astype(compute_dtype), t, compute_dtype)
+        delta = lora_delta(key, x)
+        if delta is not None:
+            y = y + delta
+        if mod.use_bias:
+            bias = mod.get_variable("params", "bias")
+            y = y + bias.astype(compute_dtype)
+        return y.astype(x.dtype) if x.dtype != y.dtype else y
+
+    with nn.intercept_methods(interceptor):
+        out = model.apply({"params": placeholders}, *args, **apply_kwargs)
+    missed = set(quant) - consumed
+    if missed:
+        # an unconsumed NF4 leaf means some module computed against its
+        # (1, 1) placeholder — fail loudly at the source
+        raise ValueError(
+            "quantized kernels not served by the fused interceptor (module "
+            f"is not an nn.Dense?): {sorted(missed)}"
+        )
+    return out
+
+
+def make_fused_qlora_loss_fn(model, qparams, cfg: lora_lib.LoRAConfig,
+                             base_loss_fn, compute_dtype=jnp.bfloat16):
+    """Like :func:`..peft.qlora.make_qlora_loss_fn` but the forward runs
+    through the fused kernel. ``base_loss_fn(apply_out_fn, batch, rng)``
+    receives a closure ``apply_out_fn(*args, **kw) -> model output``."""
+
+    def loss_fn(lora_params, batch, rng):
+        def apply_out(*args, **kw):
+            return qlora_fused_apply(
+                model, qparams, lora_params, cfg, *args,
+                compute_dtype=compute_dtype, **kw,
+            )
+
+        return base_loss_fn(apply_out, batch, rng)
+
+    return loss_fn

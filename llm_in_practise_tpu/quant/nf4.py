@@ -46,13 +46,27 @@ SCALE_BLOCK = 256   # absmax values per double-quant block
 
 @dataclasses.dataclass
 class NF4Tensor:
-    """Packed NF4 storage for one weight tensor (a pytree node)."""
+    """Packed NF4 storage for one weight tensor (a pytree node).
 
-    packed: jax.Array        # (n//2,) uint8 — two 4-bit codes per byte
-    absmax_q: jax.Array      # (n_blocks,) uint8 — double-quantized absmax
+    Two layouts (static aux data, so mixed trees jit fine):
+
+    - ``"kblock"`` (2-D ``(K, N)`` kernels with ``K % 64 == 0``, ``N`` even —
+      every transformer matmul): absmax blocks run along **K**, matching
+      bitsandbytes, whose 64-blocks run along the torch ``(out, in)``
+      weight's ``in`` dim; ``packed[k, i]`` holds ``code[k, i]`` (hi nibble)
+      and ``code[k, N//2 + i]`` (lo) — split-half pairing so the Pallas
+      fused matmul (``ops/nf4_matmul.py``) never needs a lane interleave.
+      ``absmax`` is ``(K//64, N)``.
+    - ``"flat"`` (everything else): row-major flat blocks of 64, adjacent
+      nibbles per byte.
+    """
+
+    packed: jax.Array        # uint8 — two 4-bit codes per byte
+    absmax_q: jax.Array      # uint8 — double-quantized absmax (flat)
     absmax_scale: jax.Array  # (n_scale_blocks,) f32
     absmax_offset: jax.Array # () f32 — mean of absmax before quantization
     shape: tuple[int, ...]
+    layout: str = "flat"
 
     @property
     def nbytes(self) -> int:
@@ -65,30 +79,20 @@ class NF4Tensor:
 jax.tree_util.register_pytree_node(
     NF4Tensor,
     lambda t: ((t.packed, t.absmax_q, t.absmax_scale, t.absmax_offset),
-               t.shape),
-    lambda shape, leaves: NF4Tensor(*leaves, shape=shape),
+               (t.shape, t.layout)),
+    lambda aux, leaves: NF4Tensor(*leaves, shape=aux[0], layout=aux[1]),
 )
 
 
-def quantize(w: jax.Array | np.ndarray) -> NF4Tensor:
-    """Blockwise NF4 quantization with double-quantized absmax."""
-    shape = tuple(w.shape)
-    flat = jnp.ravel(jnp.asarray(w, jnp.float32))
-    n = flat.size
-    pad = (-n) % BLOCK
-    if pad:
-        flat = jnp.pad(flat, (0, pad))
-    blocks = flat.reshape(-1, BLOCK)
-    absmax = jnp.max(jnp.abs(blocks), axis=1)                      # (nb,)
-    scaled = blocks / jnp.maximum(absmax, 1e-12)[:, None]
+def _nearest_codes(scaled: jax.Array) -> jax.Array:
     # Nearest codebook entry via searchsorted on the 15 midpoints — avoids
-    # the (nb, BLOCK, 16) broadcast a naive argmin would allocate.
+    # the (..., 16) broadcast a naive argmin would allocate.
     midpoints = (NF4_CODE[1:] + NF4_CODE[:-1]) / 2.0               # (15,)
-    codes = jnp.searchsorted(midpoints, scaled).astype(jnp.uint8)  # (nb, BLOCK)
-    codes = codes.reshape(-1)
-    packed = (codes[0::2] << 4) | codes[1::2]                      # (n_pad//2,)
+    return jnp.searchsorted(midpoints, scaled).astype(jnp.uint8)
 
-    # double quantization of absmax: subtract mean, 8-bit blockwise absmax
+
+def _double_quant(absmax: jax.Array):
+    """8-bit blockwise quantization of the (flat) absmax stream."""
     offset = jnp.mean(absmax)
     centered = absmax - offset
     s_pad = (-centered.size) % SCALE_BLOCK
@@ -98,26 +102,72 @@ def quantize(w: jax.Array | np.ndarray) -> NF4Tensor:
     s_scale = jnp.max(jnp.abs(s_blocks), axis=1) / 127.0           # (nsb,)
     q = jnp.round(s_blocks / jnp.maximum(s_scale, 1e-12)[:, None])
     absmax_q = (q + 128).astype(jnp.uint8).reshape(-1)[: absmax.size]
+    return absmax_q, s_scale, offset
 
-    return NF4Tensor(packed, absmax_q, s_scale, offset, shape)
+
+def _double_dequant(t: NF4Tensor) -> jax.Array:
+    nb = t.absmax_q.shape[0]
+    aq = t.absmax_q.astype(jnp.float32) - 128.0
+    s_pad = (-nb) % SCALE_BLOCK
+    if s_pad:
+        aq = jnp.pad(aq, (0, s_pad))
+    return (
+        aq.reshape(-1, SCALE_BLOCK) * t.absmax_scale[:, None]
+    ).reshape(-1)[:nb] + t.absmax_offset
+
+
+def quantize(w: jax.Array | np.ndarray) -> NF4Tensor:
+    """Blockwise NF4 quantization with double-quantized absmax."""
+    shape = tuple(w.shape)
+    w = jnp.asarray(w, jnp.float32)
+    if len(shape) == 2 and shape[0] % BLOCK == 0 and shape[1] % 2 == 0:
+        k, n = shape
+        blocks = w.reshape(k // BLOCK, BLOCK, n)
+        absmax = jnp.max(jnp.abs(blocks), axis=1)                  # (K/64, N)
+        scaled = blocks / jnp.maximum(absmax, 1e-12)[:, None, :]
+        codes = _nearest_codes(scaled).reshape(k, n)
+        packed = (codes[:, : n // 2] << 4) | codes[:, n // 2:]     # (K, N/2)
+        absmax_q, s_scale, offset = _double_quant(absmax.reshape(-1))
+        return NF4Tensor(packed, absmax_q, s_scale, offset, shape, "kblock")
+
+    flat = jnp.ravel(w)
+    n = flat.size
+    pad = (-n) % BLOCK
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, BLOCK)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)                      # (nb,)
+    scaled = blocks / jnp.maximum(absmax, 1e-12)[:, None]
+    codes = _nearest_codes(scaled).reshape(-1)
+    packed = (codes[0::2] << 4) | codes[1::2]                      # (n_pad//2,)
+    absmax_q, s_scale, offset = _double_quant(absmax)
+    return NF4Tensor(packed, absmax_q, s_scale, offset, shape, "flat")
+
+
+def kblock_arrays(t: NF4Tensor) -> tuple[jax.Array, jax.Array]:
+    """(packed (K, N//2) uint8, absmax (K//64, N) f32) of a kblock tensor."""
+    if t.layout != "kblock":
+        raise ValueError("not a kblock tensor")
+    k, n = t.shape
+    return t.packed, _double_dequant(t).reshape(k // BLOCK, n)
 
 
 def dequantize(t: NF4Tensor, dtype=jnp.bfloat16) -> jax.Array:
     """Pure-JAX dequant: unpack nibbles → codebook gather → absmax scale."""
+    if t.layout == "kblock":
+        k, n = t.shape
+        p = t.packed.astype(jnp.int32)
+        codes = jnp.concatenate([(p >> 4) & 0xF, p & 0xF], axis=1)  # (K, N)
+        vals = NF4_CODE[codes]
+        absmax = _double_dequant(t).reshape(k // BLOCK, 1, n)
+        w = (vals.reshape(k // BLOCK, BLOCK, n) * absmax).reshape(k, n)
+        return w.astype(dtype)
+
     hi = (t.packed >> 4).astype(jnp.int32)
     lo = (t.packed & 0xF).astype(jnp.int32)
     codes = jnp.stack([hi, lo], axis=1).reshape(-1)                # (n_pad,)
     vals = NF4_CODE[codes]
-
-    nb = t.absmax_q.shape[0]
-    s_pad = (-nb) % SCALE_BLOCK
-    aq = t.absmax_q.astype(jnp.float32) - 128.0
-    if s_pad:
-        aq = jnp.pad(aq, (0, s_pad))
-    absmax = (
-        aq.reshape(-1, SCALE_BLOCK) * t.absmax_scale[:, None]
-    ).reshape(-1)[:nb] + t.absmax_offset                           # (nb,)
-
+    absmax = _double_dequant(t)                                    # (nb,)
     w = (vals.reshape(-1, BLOCK) * absmax[:, None]).reshape(-1)
     n = int(np.prod(t.shape))
     return w[:n].reshape(t.shape).astype(dtype)

@@ -25,9 +25,11 @@ def path_str(path) -> str:
     return "/".join(_key_name(p) for p in path)
 
 
-def flatten_with_paths(tree) -> dict:
+def flatten_with_paths(tree, is_leaf=None) -> dict:
     """{path_str: leaf} for every leaf."""
     return {
         path_str(p): leaf
-        for p, leaf in jax.tree_util.tree_leaves_with_path(tree)
+        for p, leaf in jax.tree_util.tree_leaves_with_path(
+            tree, is_leaf=is_leaf
+        )
     }

@@ -1,0 +1,57 @@
+"""Synthetic server-metrics dataset for fault prediction.
+
+Counterpart of the reference's ``ML_Basics/fault_prediction_project/src/
+data_generation.py`` (synthetic metrics + fault labels): hosts emit CPU,
+memory, disk-IO, network and temperature series; faults correlate with
+sustained high CPU+temperature or memory leaks, plus label noise so the
+classifier has something honest to do.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+FEATURES = ["cpu_util", "mem_util", "disk_io", "net_io", "temperature"]
+
+
+def generate_metrics(n_samples: int = 5000, seed: int = 7) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    # mixture of healthy hosts and a stressed subpopulation (~20%) so
+    # faults are concentrated and genuinely learnable, not label noise
+    stressed = rng.random(n_samples) < 0.2
+    cpu = np.where(
+        stressed,
+        np.clip(75 + rng.normal(10, 8, n_samples), 0, 100),
+        np.clip(rng.beta(2, 5, n_samples) * 100 + rng.normal(0, 5, n_samples), 0, 100),
+    )
+    mem = np.where(
+        stressed,
+        np.clip(70 + rng.normal(12, 10, n_samples), 0, 100),
+        np.clip(rng.beta(3, 4, n_samples) * 100 + rng.normal(0, 5, n_samples), 0, 100),
+    )
+    disk = np.clip(rng.gamma(2, 20, n_samples) * np.where(stressed, 2.0, 1.0), 0, 400)
+    net = np.clip(rng.gamma(2, 30, n_samples), 0, 600)
+    temp = np.clip(35 + cpu * 0.35 + rng.normal(0, 3, n_samples), 25, 100)
+
+    risk = (
+        0.08 * np.maximum(cpu - 60, 0)
+        + 0.06 * np.maximum(mem - 60, 0)
+        + 0.12 * np.maximum(temp - 60, 0)
+        + 0.005 * np.maximum(disk - 200, 0)
+    )
+    fault = (rng.random(n_samples) < 1 / (1 + np.exp(4.0 - risk))).astype(np.int32)
+
+    df = pd.DataFrame({
+        "cpu_util": cpu, "mem_util": mem, "disk_io": disk,
+        "net_io": net, "temperature": temp, "fault": fault,
+    })
+    return df
+
+
+def train_test_split_df(df: pd.DataFrame, test_fraction: float = 0.2,
+                        seed: int = 7):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(df))
+    n_test = int(len(df) * test_fraction)
+    return df.iloc[idx[n_test:]], df.iloc[idx[:n_test]]

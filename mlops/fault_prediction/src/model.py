@@ -1,0 +1,96 @@
+"""Fault-prediction classifier: a small jitted JAX MLP.
+
+The reference trains a sklearn RandomForest (``ML_Basics/
+fault_prediction_project/src/model_training.py``); here the same service
+contract is met TPU-natively — a 2-layer MLP in pure JAX (no framework
+import needed beyond jax/optax), standardized features, trained with the
+in-repo AdamW, saved as msgpack next to its normalization stats.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization
+
+from mlops.fault_prediction.src.data_generation import FEATURES
+
+
+def init_params(rng, n_features: int, hidden: int = 32):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (n_features, hidden)) * 0.3,
+        "b1": jnp.zeros((hidden,)),
+        "w2": jax.random.normal(k2, (hidden, 1)) * 0.3,
+        "b2": jnp.zeros((1,)),
+    }
+
+
+def forward(params, x):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return (h @ params["w2"] + params["b2"])[..., 0]
+
+
+def train(df, *, epochs: int = 300, lr: float = 1e-2, seed: int = 0):
+    if epochs < 1:
+        raise ValueError("epochs must be >= 1")
+    x = jnp.asarray(df[FEATURES].to_numpy(np.float32))
+    y = jnp.asarray(df["fault"].to_numpy(np.float32))
+    mean, std = x.mean(0), x.std(0) + 1e-6
+    xn = (x - mean) / std
+
+    params = init_params(jax.random.PRNGKey(seed), len(FEATURES))
+    tx = optax.adamw(lr)
+    opt_state = tx.init(params)
+
+    # class weighting: faults are rare; weight positives by the inverse
+    # base rate so the classifier can't win by predicting all-clear
+    pos_weight = float((1 - y.mean()) / jnp.maximum(y.mean(), 1e-3))
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = forward(p, xn)
+            per = optax.sigmoid_binary_cross_entropy(logits, y)
+            w = jnp.where(y > 0.5, pos_weight, 1.0)
+            return (per * w).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(epochs):
+        params, opt_state, loss = step(params, opt_state)
+    return {"params": params, "mean": mean, "std": std}, float(loss)
+
+
+def predict_proba(model, features: np.ndarray) -> np.ndarray:
+    x = (jnp.asarray(features, jnp.float32) - model["mean"]) / model["std"]
+    return np.asarray(jax.nn.sigmoid(forward(model["params"], x)))
+
+
+def evaluate(model, df) -> dict:
+    probs = predict_proba(model, df[FEATURES].to_numpy(np.float32))
+    pred = (probs > 0.5).astype(np.int32)
+    y = df["fault"].to_numpy(np.int32)
+    tp = int(((pred == 1) & (y == 1)).sum())
+    fp = int(((pred == 1) & (y == 0)).sum())
+    fn = int(((pred == 0) & (y == 1)).sum())
+    return {
+        "accuracy": float((pred == y).mean()),
+        "precision": tp / max(tp + fp, 1),
+        "recall": tp / max(tp + fn, 1),
+        "base_rate": float(y.mean()),
+    }
+
+
+def save(model, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(model)))
+
+
+def load(path: str):
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())

@@ -1,0 +1,74 @@
+"""HTTP prediction service: ``POST /predict_fault`` + ``GET /health``.
+
+Counterpart of the reference's Flask service (``ML_Basics/
+fault_prediction_project/src/model_service.py:17-23``) on the repo's
+stdlib HTTP base — same route name and JSON contract:
+``{"cpu_util": .., "mem_util": .., "disk_io": .., "net_io": ..,
+"temperature": ..} -> {"fault_probability": p, "fault_predicted": bool}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from http.server import ThreadingHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+
+from llm_in_practise_tpu.serve.http_util import JsonHandler
+from mlops.fault_prediction.src import model as model_lib
+from mlops.fault_prediction.src.data_generation import FEATURES
+
+
+def make_handler(model, threshold: float = 0.5):
+    class Handler(JsonHandler):
+        def do_GET(self):
+            if self.path == "/health":
+                return self._json(200, {"status": "ok"})
+            return self._json(404, {"error": {"message": "not found"}})
+
+        def do_POST(self):
+            if self.path != "/predict_fault":
+                return self._json(404, {"error": {"message": "not found"}})
+            body, err = self._read_json()
+            if err:
+                return self._json(400, err)
+            missing = [f for f in FEATURES if f not in body]
+            if missing:
+                return self._json(400, {"error": {
+                    "message": f"missing features: {missing}"}})
+            feats = np.asarray([[float(body[f]) for f in FEATURES]])
+            prob = float(model_lib.predict_proba(model, feats)[0])
+            return self._json(200, {
+                "fault_probability": round(prob, 4),
+                "fault_predicted": prob > threshold,
+            })
+
+    return Handler
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_path", default="/tmp/fault_model.msgpack")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=5000)
+    args = p.parse_args()
+
+    if not os.path.exists(args.model_path):
+        from mlops.fault_prediction.src.data_generation import generate_metrics
+
+        print("no model found — training one")
+        model, loss = model_lib.train(generate_metrics())
+        model_lib.save(model, args.model_path)
+    model = model_lib.load(args.model_path)
+    print(f"serving fault prediction on {args.host}:{args.port}")
+    ThreadingHTTPServer((args.host, args.port),
+                        make_handler(model)).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

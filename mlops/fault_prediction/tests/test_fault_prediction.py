@@ -1,0 +1,74 @@
+"""Unit tests mirroring the reference's only formal test file
+(fault_prediction_project/tests/test_data_generation.py: shape/column
+assertions) plus model-quality and service-contract checks."""
+
+import os
+import sys
+import threading
+from http.server import ThreadingHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import json
+import urllib.request
+
+from mlops.fault_prediction.src import model as model_lib
+from mlops.fault_prediction.src.data_generation import (
+    FEATURES,
+    generate_metrics,
+    train_test_split_df,
+)
+from mlops.fault_prediction.src.service import make_handler
+
+
+def test_data_shape_and_columns():
+    df = generate_metrics(500)
+    assert len(df) == 500
+    assert set(FEATURES + ["fault"]) == set(df.columns)
+    assert df["fault"].isin((0, 1)).all()
+    assert 0.01 < df["fault"].mean() < 0.6  # non-degenerate labels
+
+
+def test_model_learns_better_than_base_rate():
+    df = generate_metrics(3000)
+    train_df, test_df = train_test_split_df(df)
+    model, _ = model_lib.train(train_df, epochs=200)
+    m = model_lib.evaluate(model, test_df)
+    assert m["accuracy"] > 1 - m["base_rate"]  # beats always-0
+    assert m["recall"] > 0.2
+
+
+def test_service_contract():
+    df = generate_metrics(1000)
+    model, _ = model_lib.train(df, epochs=50)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(model))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict_fault",
+            data=json.dumps({
+                "cpu_util": 95, "mem_util": 92, "disk_io": 300,
+                "net_io": 100, "temperature": 85,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        assert 0.0 <= body["fault_probability"] <= 1.0
+        assert isinstance(body["fault_predicted"], bool)
+        # hot box should look riskier than an idle one
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict_fault",
+            data=json.dumps({
+                "cpu_util": 5, "mem_util": 10, "disk_io": 5,
+                "net_io": 5, "temperature": 36,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2) as r:
+            idle = json.loads(r.read())
+        assert body["fault_probability"] > idle["fault_probability"]
+    finally:
+        httpd.shutdown()

@@ -1,0 +1,36 @@
+"""Train + evaluate + save the fault-prediction model (the retrain job's
+entry point — run by the K8s CronJob the way the reference's
+``model_training.py`` is)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from mlops.fault_prediction.src import model as model_lib
+from mlops.fault_prediction.src.data_generation import (
+    generate_metrics,
+    train_test_split_df,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_samples", type=int, default=5000)
+    p.add_argument("--epochs", type=int, default=300)
+    p.add_argument("--out", default="/tmp/fault_model.msgpack")
+    args = p.parse_args()
+
+    df = generate_metrics(args.n_samples)
+    train_df, test_df = train_test_split_df(df)
+    model, loss = model_lib.train(train_df, epochs=args.epochs)
+    metrics = model_lib.evaluate(model, test_df)
+    print(f"train loss {loss:.4f} | test {metrics}")
+    model_lib.save(model, args.out)
+    print(f"saved {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Pipeline runner: generate -> train detector+classifier -> report -> save
+(the reference's run-the-pipeline script, config-driven)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from mlops.server_failure_rca.src.pipeline import RCAConfig, train
+
+
+def main():
+    p = argparse.ArgumentParser()
+    default_cfg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "config", "config.json")
+    p.add_argument("--config", default=default_cfg)
+    p.add_argument("--out", default="/tmp/rca_model.pkl")
+    args = p.parse_args()
+
+    cfg = RCAConfig.from_file(args.config)
+    model, metrics = train(cfg)
+    print(f"pipeline metrics: {metrics}")
+    model.save(args.out)
+    print(f"saved {args.out}")
+
+
+if __name__ == "__main__":
+    main()

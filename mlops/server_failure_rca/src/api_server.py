@@ -1,0 +1,88 @@
+"""RCA API: ``POST /predict``, ``POST /batch_predict``, ``GET /health``.
+
+Counterpart of the reference's FastAPI server
+(``ML_Basics/server_failure_rca/scripts/api_server.py:69-127``) on the
+repo's stdlib HTTP base — same three routes and JSON shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from http.server import ThreadingHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+
+from llm_in_practise_tpu.serve.http_util import JsonHandler
+from mlops.server_failure_rca.src.pipeline import FEATURES, RCAConfig, RCAModel, train
+
+
+def _features_from(record: dict):
+    missing = [f for f in FEATURES if f not in record]
+    if missing:
+        return None, missing
+    return [float(record[f]) for f in FEATURES], None
+
+
+def make_handler(model: RCAModel):
+    class Handler(JsonHandler):
+        def do_GET(self):
+            if self.path == "/health":
+                return self._json(200, {"status": "ok"})
+            return self._json(404, {"error": {"message": "not found"}})
+
+        def do_POST(self):
+            body, err = self._read_json()
+            if err:
+                return self._json(400, err)
+            if self.path == "/predict":
+                feats, missing = _features_from(body)
+                if missing:
+                    return self._json(400, {"error": {
+                        "message": f"missing features: {missing}"}})
+                return self._json(200, model.analyze(np.asarray([feats]))[0])
+            if self.path == "/batch_predict":
+                records = body.get("records")
+                if not isinstance(records, list) or not records:
+                    return self._json(400, {"error": {
+                        "message": "records must be a non-empty list"}})
+                rows = []
+                for r in records:
+                    feats, missing = _features_from(r)
+                    if missing:
+                        return self._json(400, {"error": {
+                            "message": f"missing features: {missing}"}})
+                    rows.append(feats)
+                return self._json(200, {
+                    "results": model.analyze(np.asarray(rows))})
+            return self._json(404, {"error": {"message": "not found"}})
+
+    return Handler
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_path", default="/tmp/rca_model.pkl")
+    p.add_argument("--config", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=5001)
+    args = p.parse_args()
+
+    cfg = RCAConfig.from_file(args.config) if args.config else RCAConfig()
+    if not os.path.exists(args.model_path):
+        print("no model found — running the training pipeline")
+        model, metrics = train(cfg)
+        print(f"trained: {metrics}")
+        model.save(args.model_path)
+    model = RCAModel.load(args.model_path)
+    print(f"serving RCA on {args.host}:{args.port}")
+    ThreadingHTTPServer((args.host, args.port),
+                        make_handler(model)).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

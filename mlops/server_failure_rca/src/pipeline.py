@@ -1,0 +1,146 @@
+"""Server-failure root-cause analysis: anomaly detection + RCA classifier.
+
+Counterpart of the reference's ``ML_Basics/server_failure_rca/`` project:
+preprocessing, IsolationForest anomaly detection
+(``src/anomaly_detection.py:23``), RandomForest root-cause classification
+(``src/model_training.py:30``), and a pipeline runner — here as one module
+with a YAML-free dataclass/JSON config (``config/config.json``).
+
+Stages: synthesize labeled incident telemetry → standardize → flag
+anomalous windows (IsolationForest) → classify the root cause of flagged
+windows (RandomForest over the same features) → persist both models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pickle
+
+import numpy as np
+import pandas as pd
+from sklearn.ensemble import IsolationForest, RandomForestClassifier
+from sklearn.preprocessing import StandardScaler
+
+FEATURES = [
+    "cpu_util", "mem_util", "disk_latency_ms", "net_errors",
+    "swap_rate", "load_avg",
+]
+
+ROOT_CAUSES = ["none", "cpu_saturation", "memory_leak", "disk_degraded",
+               "network_fault"]
+
+
+@dataclasses.dataclass
+class RCAConfig:
+    n_samples: int = 6000
+    anomaly_contamination: float = 0.15
+    n_estimators: int = 120
+    max_depth: int = 8
+    seed: int = 13
+
+    @classmethod
+    def from_file(cls, path: str) -> "RCAConfig":
+        with open(path) as f:
+            return cls(**json.load(f))
+
+
+def generate_incidents(cfg: RCAConfig) -> pd.DataFrame:
+    """Telemetry windows: healthy baseline + four incident signatures."""
+    rng = np.random.default_rng(cfg.seed)
+    n = cfg.n_samples
+    # incident rate ≈ the detector's contamination prior (RCAConfig default)
+    cause = rng.choice(len(ROOT_CAUSES), n, p=[0.86, 0.04, 0.04, 0.03, 0.03])
+
+    cpu = np.clip(rng.normal(35, 12, n), 0, 100)
+    mem = np.clip(rng.normal(45, 12, n), 0, 100)
+    disk = np.clip(rng.gamma(2, 4, n), 0.5, 300)
+    net = rng.poisson(1, n).astype(float)
+    swap = np.clip(rng.gamma(1.5, 2, n), 0, 200)
+    load = np.clip(rng.normal(1.5, 0.8, n), 0, 64)
+
+    cpu = np.where(cause == 1, np.clip(rng.normal(95, 4, n), 80, 100), cpu)
+    load = np.where(cause == 1, np.clip(rng.normal(24, 6, n), 8, 64), load)
+    mem = np.where(cause == 2, np.clip(rng.normal(93, 4, n), 80, 100), mem)
+    swap = np.where(cause == 2, np.clip(rng.normal(120, 30, n), 40, 200), swap)
+    disk = np.where(cause == 3, np.clip(rng.normal(150, 40, n), 60, 300), disk)
+    net = np.where(cause == 4, rng.poisson(40, n).astype(float), net)
+
+    df = pd.DataFrame({
+        "cpu_util": cpu, "mem_util": mem, "disk_latency_ms": disk,
+        "net_errors": net, "swap_rate": swap, "load_avg": load,
+        "root_cause": [ROOT_CAUSES[c] for c in cause],
+    })
+    return df
+
+
+@dataclasses.dataclass
+class RCAModel:
+    scaler: StandardScaler
+    detector: IsolationForest
+    classifier: RandomForestClassifier
+
+    def analyze(self, features: np.ndarray) -> list[dict]:
+        """Per row: anomaly verdict + score; root cause when anomalous."""
+        xs = self.scaler.transform(features)
+        flags = self.detector.predict(xs) == -1
+        scores = -self.detector.score_samples(xs)
+        causes = self.classifier.predict(xs)
+        probs = self.classifier.predict_proba(xs).max(axis=1)
+        out = []
+        for i in range(len(features)):
+            row = {
+                "anomaly": bool(flags[i]),
+                "anomaly_score": round(float(scores[i]), 4),
+            }
+            if flags[i]:
+                row["root_cause"] = str(causes[i])
+                row["confidence"] = round(float(probs[i]), 4)
+            out.append(row)
+        return out
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+
+    @staticmethod
+    def load(path: str) -> "RCAModel":
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+def train(cfg: RCAConfig, df: pd.DataFrame | None = None) -> tuple[RCAModel, dict]:
+    df = generate_incidents(cfg) if df is None else df
+    x = df[FEATURES].to_numpy(np.float64)
+    y = df["root_cause"].to_numpy()
+
+    scaler = StandardScaler().fit(x)
+    xs = scaler.transform(x)
+
+    detector = IsolationForest(
+        contamination=cfg.anomaly_contamination, random_state=cfg.seed,
+        n_estimators=cfg.n_estimators,
+    ).fit(xs)
+
+    # RCA classifies *failure* causes: train on incident rows only, so a
+    # flagged window never comes back labeled "none" (a contradictory
+    # anomaly=true/root_cause=none payload downstream).
+    incident_mask = y != "none"
+    if not incident_mask.any():
+        raise ValueError("training data contains no incidents")
+    classifier = RandomForestClassifier(
+        n_estimators=cfg.n_estimators, max_depth=cfg.max_depth,
+        random_state=cfg.seed, class_weight="balanced",
+    ).fit(xs[incident_mask], y[incident_mask])
+
+    model = RCAModel(scaler, detector, classifier)
+    flags = detector.predict(xs) == -1
+    incident = y != "none"
+    metrics = {
+        "anomaly_recall": float((flags & incident).sum() / max(incident.sum(), 1)),
+        "rca_accuracy_on_incidents": float(
+            (classifier.predict(xs[incident]) == y[incident]).mean()
+        ),
+        "incident_rate": float(incident.mean()),
+    }
+    return model, metrics

@@ -1,0 +1,70 @@
+"""Pipeline + API contract tests (anomaly recall, RCA accuracy, routes)."""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+
+import numpy as np
+import pytest
+
+from mlops.server_failure_rca.src.api_server import make_handler
+from mlops.server_failure_rca.src.pipeline import (
+    FEATURES,
+    RCAConfig,
+    generate_incidents,
+    train,
+)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    cfg = RCAConfig(n_samples=3000)
+    return train(cfg)
+
+
+def test_pipeline_quality(trained):
+    model, metrics = trained
+    assert metrics["anomaly_recall"] > 0.7
+    assert metrics["rca_accuracy_on_incidents"] > 0.8
+
+
+def test_incident_signatures_detected(trained):
+    model, _ = trained
+    cpu_sat = [[97.0, 50.0, 8.0, 1.0, 5.0, 28.0]]
+    healthy = [[30.0, 40.0, 6.0, 0.0, 2.0, 1.2]]
+    r_bad = model.analyze(np.asarray(cpu_sat))[0]
+    r_ok = model.analyze(np.asarray(healthy))[0]
+    assert r_bad["anomaly"] and r_bad["root_cause"] == "cpu_saturation"
+    assert not r_ok["anomaly"]
+
+
+def test_api_routes(trained):
+    model, _ = trained
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(model))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/health") as r:
+            assert json.loads(r.read())["status"] == "ok"
+        rec = dict(zip(FEATURES, [95.0, 50.0, 8.0, 1.0, 5.0, 30.0]))
+        req = urllib.request.Request(
+            f"{base}/predict", data=json.dumps(rec).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        assert body["anomaly"] is True and "root_cause" in body
+        req = urllib.request.Request(
+            f"{base}/batch_predict",
+            data=json.dumps({"records": [rec, rec]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert len(json.loads(r.read())["results"]) == 2
+    finally:
+        httpd.shutdown()

@@ -6,6 +6,7 @@ chunking (``ddp_gpt_wikitext2.py:62-77``), and −100 masking span checks for
 SFT (``qwen3-8b-lora.py:62-99``).
 """
 
+import json
 import numpy as np
 import pytest
 
@@ -160,3 +161,29 @@ class TestSFT:
         )
         n_real = int(batch.attention_mask[0].sum())
         assert (batch.input_ids[0][n_real:] == bpe.pad_id).all()
+
+
+class TestConverters:
+    def test_self_cognition_to_alpaca(self, tmp_path):
+        from llm_in_practise_tpu.data.converters import (
+            alpaca_to_messages,
+            convert_file,
+            self_cognition_to_alpaca,
+        )
+
+        records = [
+            {"query": "Who are you?",
+             "response": "I am {{NAME}} by {{AUTHOR}}.", "tag": "en"},
+        ]
+        out = self_cognition_to_alpaca(records, name="Bot", author="Team")
+        assert out == [{"instruction": "Who are you?", "input": "",
+                        "output": "I am Bot by Team."}]
+
+        src = tmp_path / "sc.jsonl"
+        src.write_text("\n".join(json.dumps(r) for r in records))
+        dst = tmp_path / "alpaca.json"
+        n = convert_file(str(src), str(dst), name="Bot", author="Team")
+        assert n == 1 and json.loads(dst.read_text())[0]["output"].endswith("Team.")
+
+        msgs = alpaca_to_messages(out[0], system_prompt="sys")
+        assert [m["role"] for m in msgs] == ["system", "user", "assistant"]
