@@ -1,4 +1,10 @@
-from llm_in_practise_tpu.parallel import strategy
+from llm_in_practise_tpu.parallel import pipeline, strategy
+from llm_in_practise_tpu.parallel.pipeline import (
+    make_pipeline_loss_fn,
+    merge_gpt_params,
+    pipeline_mesh,
+    split_gpt_params,
+)
 from llm_in_practise_tpu.parallel.strategy import (
     DEFAULT_RULES,
     Strategy,
@@ -22,8 +28,13 @@ __all__ = [
     "expert_parallel",
     "fsdp",
     "fsdp_tp",
+    "make_pipeline_loss_fn",
+    "merge_gpt_params",
     "param_shardings",
+    "pipeline",
+    "pipeline_mesh",
     "shard_init",
+    "split_gpt_params",
     "strategy",
     "tensor_parallel",
     "zero1",
