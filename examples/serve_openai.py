@@ -42,6 +42,10 @@ def main():
                    default=[], metavar="NAME=PATH",
                    help="serve LoRA adapters as extra model names "
                         "(vLLM --lora-modules parity)")
+    p.add_argument("--enable-prefix-caching", dest="prefix_caching",
+                   action="store_true",
+                   help="reuse prompt-prefix KV across requests "
+                        "(vLLM APC parity)")
     args = p.parse_args()
 
     tok = BPETokenizer.load(args.tokenizer_path)
@@ -54,6 +58,7 @@ def main():
     engine_kw = dict(
         max_slots=args.max_slots, cache_len=args.cache_len,
         eos_id=tok.token_to_id(IM_END), cache_dtype=jnp.float32,
+        prefix_cache=args.prefix_caching,
     )
     engine = InferenceEngine(model, params, **engine_kw)
     adapters = {}

@@ -152,6 +152,20 @@ class OpenAIServer:
                 f"{name}_count {len(vals)}",
                 f"{name}_sum {sum(vals):.6f}",
             ]
+        pc = self.engine.prefix_cache
+        if pc is not None:
+            lines += [
+                "# TYPE llm_prefix_cache_hits_total counter",
+                f"llm_prefix_cache_hits_total {pc.hits}",
+                "# TYPE llm_prefix_cache_full_hits_total counter",
+                f"llm_prefix_cache_full_hits_total {pc.full_hits}",
+                "# TYPE llm_prefix_cache_misses_total counter",
+                f"llm_prefix_cache_misses_total {pc.misses}",
+                "# TYPE llm_prefix_cache_tokens_saved_total counter",
+                f"llm_prefix_cache_tokens_saved_total {pc.tokens_saved}",
+                "# TYPE llm_prefix_cache_tokens gauge",
+                f"llm_prefix_cache_tokens {pc.cached_tokens}",
+            ]
         return "\n".join(lines) + "\n"
 
     # --- HTTP plumbing -------------------------------------------------------

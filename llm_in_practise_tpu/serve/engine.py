@@ -147,6 +147,7 @@ class InferenceEngine:
         cache_dtype=jnp.bfloat16,
         prefill_buckets: tuple[int, ...] | None = None,
         rng: jax.Array | None = None,
+        prefix_cache: "PrefixCache | bool | None" = None,
     ):
         self.model = model
         self.params = params
@@ -183,10 +184,20 @@ class InferenceEngine:
         self._wake = threading.Event()  # set on submit; idle loop waits on it
         self._thread: threading.Thread | None = None
 
+        # Prefix caching (vLLM APC parity): True -> default-sized cache.
+        from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+        if prefix_cache is True:
+            prefix_cache = PrefixCache()
+        self.prefix_cache = prefix_cache or None
+
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
         self._prefill = jax.jit(self._prefill_fn)
+        self._prefill_suffix = jax.jit(self._prefill_suffix_fn)
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,),
                                static_argnames=("slot",))
+        self._insert_rows = jax.jit(self._insert_rows_fn, donate_argnums=(0,),
+                                    static_argnames=("slot",))
 
     # --- jitted pieces -------------------------------------------------------
 
@@ -216,6 +227,33 @@ class InferenceEngine:
         )[:, 0, :]
         return last, cache
 
+    def _prefill_suffix_fn(self, params, prefix_rows, prefix_len,
+                           suffix_ids, suffix_len):
+        """Prefill only the prompt suffix over pre-inserted prefix KV rows.
+
+        ``prefix_rows``: per-layer {key: (1, bucket, ...)}; positions and
+        causal masking follow from the cache index (= prefix_len), so this
+        equals a cold prefill of the full prompt.
+        """
+        cache = self.model.init_cache(1, self.cache_len, dtype=self.cache_dtype)
+        primed = []
+        for layer, rows in zip(cache, prefix_rows):
+            new = {"index": jnp.full_like(layer["index"], prefix_len)}
+            for key, buf in layer.items():
+                if key == "index":
+                    continue
+                new[key] = jax.lax.dynamic_update_slice_in_dim(
+                    buf, rows[key].astype(buf.dtype), 0, axis=1
+                )
+            primed.append(new)
+        logits, cache = self.model.apply(
+            {"params": params}, suffix_ids, deterministic=True, cache=primed
+        )
+        last = jnp.take_along_axis(
+            logits, (suffix_len - 1)[None, None, None], axis=1
+        )[:, 0, :]
+        return last, cache
+
     def _insert_fn(self, engine_cache, prefill_cache, slot: int, length):
         """Copy a prefilled request's cache rows into ``slot``."""
         new = []
@@ -226,6 +264,22 @@ class InferenceEngine:
                     layer["index"] = eng["index"].at[slot].set(length)
                 else:
                     layer[key] = eng[key].at[slot].set(pre[key][0])
+            new.append(layer)
+        return new
+
+    def _insert_rows_fn(self, engine_cache, rows, slot: int, length):
+        """Copy stored prefix rows (bucket-length) directly into ``slot``."""
+        new = []
+        for eng, layer_rows in zip(engine_cache, rows):
+            layer = {}
+            for key in eng:
+                if key == "index":
+                    layer["index"] = eng["index"].at[slot].set(length)
+                else:
+                    bucket = layer_rows[key].shape[1]
+                    layer[key] = eng[key].at[slot, :bucket].set(
+                        layer_rows[key][0].astype(eng[key].dtype)
+                    )
             new.append(layer)
         return new
 
@@ -262,15 +316,7 @@ class InferenceEngine:
             except queue.Empty:
                 break
             plen = len(req.prompt_ids)
-            bucket = self._bucket_for(plen)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :plen] = req.prompt_ids
-            last_logits, pre_cache = self._prefill(
-                self.params, jnp.asarray(padded), jnp.asarray(plen, jnp.int32)
-            )
-            self.cache = self._insert(
-                self.cache, pre_cache, slot, jnp.asarray(plen, jnp.int32)
-            )
+            last_logits = self._prefill_into_slot(req, slot, plen)
             # First generated token comes from the prefill logits.
             self.rng, sub = jax.random.split(self.rng)
             first = sample_token_batched(
@@ -298,6 +344,54 @@ class InferenceEngine:
             self.stats.queue_depth = self.pending.qsize()
             self.stats.active_slots = sum(r is not None for r in self.slot_req)
         return admitted
+
+    def _prefill_into_slot(self, req: Request, slot: int, plen: int):
+        """Prefill the prompt (reusing any cached prefix) into ``slot``;
+        returns the last-position logits."""
+        from llm_in_practise_tpu.serve import prefix_cache as pc
+
+        def usable(entry) -> bool:
+            # the suffix's padded bucket must land inside cache_len, or the
+            # scatter would clamp and corrupt the prefix KV
+            if entry.length == plen:
+                return True
+            sbucket = self._bucket_for(plen - entry.length)
+            return entry.length + sbucket <= self.cache_len
+
+        hit = (self.prefix_cache.lookup(req.prompt_ids, usable)
+               if self.prefix_cache is not None else None)
+        if hit is not None and hit.length == plen:
+            # full-prompt hit: no prefill at all
+            self.cache = self._insert_rows(
+                self.cache, hit.rows, slot, jnp.asarray(plen, jnp.int32))
+            return hit.last_logits
+
+        if hit is not None:
+            suffix = req.prompt_ids[hit.length:]
+            sbucket = self._bucket_for(len(suffix))
+            padded = np.zeros((1, sbucket), np.int32)
+            padded[0, :len(suffix)] = suffix
+            last_logits, pre_cache = self._prefill_suffix(
+                self.params, hit.rows, jnp.asarray(hit.length, jnp.int32),
+                jnp.asarray(padded), jnp.asarray(len(suffix), jnp.int32),
+            )
+        else:
+            bucket = self._bucket_for(plen)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = req.prompt_ids
+            last_logits, pre_cache = self._prefill(
+                self.params, jnp.asarray(padded), jnp.asarray(plen, jnp.int32)
+            )
+        if self.prefix_cache is not None and (hit is None or hit.length < plen):
+            self.prefix_cache.put(req.prompt_ids, pc.PrefixEntry(
+                length=plen, bucket=self._bucket_for(plen),
+                rows=pc.slice_cache_rows(pre_cache, self._bucket_for(plen)),
+                last_logits=last_logits,
+            ))
+        self.cache = self._insert(
+            self.cache, pre_cache, slot, jnp.asarray(plen, jnp.int32)
+        )
+        return last_logits
 
     def _emit(self, slot: int, token_id: int):
         req = self.slot_req[slot]

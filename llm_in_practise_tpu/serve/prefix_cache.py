@@ -1,0 +1,117 @@
+"""Prefix KV caching — the reference platform's L1 cache stage, in-engine.
+
+The reference gets prompt-prefix reuse from vLLM's automatic prefix
+caching (``07-L1-Cache/vllm-statefulset-apc.yaml`` —
+``--enable-prefix-caching``) and from LMCache's remote KV pool
+(``vllm-statefulset-lmcache.yaml:65-111``); warm-prefix TTFT drops from
+800–1500 ms to 50–200 ms (``Inference_Platfrom/README.md:1336-1341``).
+
+Here the same idea fits the slot engine's static-shape world: after a
+prompt prefills, its per-layer KV rows (padded to the prefill bucket) are
+kept in an LRU keyed by the token tuple. A new request reuses the longest
+cached strict prefix — the engine then prefills only the suffix, with the
+prefix rows pre-inserted and the cache index offset (positions and causal
+masking follow from the index, so the math is identical to a cold
+prefill). A full-prompt hit skips prefill entirely (the stored
+last-position logits seed the first sampled token).
+
+Eviction: LRU by total cached tokens. Entries are device arrays — the
+budget is HBM, so default caps are modest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+
+import jax
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    length: int           # true token count of the cached prefix
+    bucket: int           # padded length of the stored rows
+    rows: list            # per-layer {key: (1, bucket, ...) device array}
+    last_logits: object   # (1, vocab) logits at the final prefix position
+
+
+class PrefixCache:
+    """LRU of prompt-prefix KV rows, keyed by exact token tuples."""
+
+    def __init__(self, *, max_tokens: int = 32768, min_prefix: int = 16):
+        self.max_tokens = max_tokens
+        self.min_prefix = min_prefix
+        self._entries: "OrderedDict[tuple, PrefixEntry]" = OrderedDict()
+        # internal lock: the engine thread mutates while /metrics reads
+        self._lock = threading.Lock()
+        self._total_tokens = 0
+        self.hits = 0
+        self.full_hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+
+    @property
+    def cached_tokens(self) -> int:
+        with self._lock:
+            return self._total_tokens
+
+    def lookup(self, prompt_ids: list[int], usable=None) -> PrefixEntry | None:
+        """Longest cached entry that is a prefix of ``prompt_ids``.
+
+        ``usable(entry)`` (optional) filters candidates — the engine uses it
+        to reject prefixes whose suffix prefill wouldn't fit the cache.
+        """
+        prompt = tuple(prompt_ids)
+        with self._lock:
+            best_key, best = None, None
+            for key, entry in self._entries.items():
+                if entry.length < self.min_prefix or entry.length > len(prompt):
+                    continue
+                if best is not None and entry.length <= best.length:
+                    continue
+                if prompt[: entry.length] != key:
+                    continue
+                if usable is not None and not usable(entry):
+                    continue
+                best_key, best = key, entry
+            if best is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(best_key)
+            self.hits += 1
+            if best.length == len(prompt):
+                self.full_hits += 1
+            self.tokens_saved += best.length
+            return best
+
+    def put(self, prompt_ids: list[int], entry: PrefixEntry) -> None:
+        if entry.length < self.min_prefix:
+            return
+        key = tuple(prompt_ids[: entry.length])
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._total_tokens -= old.length
+            self._entries[key] = entry
+            self._total_tokens += entry.length
+            while self._total_tokens > self.max_tokens and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self._total_tokens -= evicted.length
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._total_tokens = 0
+
+
+def slice_cache_rows(prefill_cache, bucket: int) -> list:
+    """Keep only the first ``bucket`` rows of each layer's KV buffers
+    (drop the per-layer index — the entry carries the true length)."""
+    rows = []
+    for layer in prefill_cache:
+        rows.append({
+            k: jax.lax.slice_in_dim(v, 0, bucket, axis=1)
+            for k, v in layer.items() if k != "index"
+        })
+    return rows

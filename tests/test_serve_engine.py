@@ -122,3 +122,73 @@ def test_eos_stops_generation(rng):
         pass
     assert req.result() == ref[:3]
     assert req.finish_reason == "stop"
+
+
+def test_prefix_cache_exactness_and_hits(rng):
+    """APC parity: cached-prefix decode must equal cold decode exactly
+    (full hit, partial hit), with hit accounting."""
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+    model, params = _tiny_model(rng)
+    pc = PrefixCache(min_prefix=8)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        prefix_cache=pc,
+    )
+    prompt = list(range(2, 26))  # 24 tokens >= min_prefix
+    sp = SamplingParams(greedy=True, max_tokens=8)
+
+    cold = engine.generate(prompt, sp)
+    assert pc.misses == 1 and pc.hits == 0
+
+    # identical prompt -> full hit, same tokens, no new prefill
+    warm = engine.generate(prompt, sp)
+    assert warm == cold
+    assert pc.full_hits == 1
+
+    # extended prompt -> partial hit (suffix prefill), equals cold reference
+    longer = prompt + [30, 31, 32, 33, 34]
+    warm_ext = engine.generate(longer, sp)
+    assert pc.hits == 2
+    ref = _ref_greedy(model, params, longer, 8)
+    assert warm_ext == ref, (warm_ext, ref)
+
+    # a fresh engine without the cache agrees on the original prompt
+    engine2 = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+    )
+    assert engine2.generate(prompt, sp) == cold
+
+
+def test_prefix_cache_lru_eviction():
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache, PrefixEntry
+
+    pc = PrefixCache(max_tokens=40, min_prefix=4)
+    for start in (0, 100, 200):
+        ids = list(range(start, start + 16))
+        pc.put(ids, PrefixEntry(length=16, bucket=16, rows=[],
+                                last_logits=None))
+    assert pc.cached_tokens <= 40  # oldest evicted
+    assert pc.lookup(list(range(0, 16))) is None        # evicted
+    assert pc.lookup(list(range(200, 216))) is not None  # newest kept
+
+
+def test_prefix_cache_overflow_falls_back_to_cold(rng):
+    """A cached prefix whose suffix bucket would overflow cache_len must be
+    rejected (clamped scatter would corrupt the prefix KV)."""
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+    model, params = _tiny_model(rng)
+    pc = PrefixCache(min_prefix=8)
+    engine = InferenceEngine(
+        model, params, max_slots=1, cache_len=128, cache_dtype=jnp.float32,
+        prefix_cache=pc,
+    )
+    sp = SamplingParams(greedy=True, max_tokens=4)
+    prefix = [(i % 60) + 1 for i in range(100)]
+    engine.generate(prefix, sp)                      # caches 100-token prefix
+    # 20-token suffix -> bucket 32; 100 + 32 > 128 -> prefix unusable
+    longer = prefix + [(i % 60) + 1 for i in range(20)]
+    got = engine.generate(longer, sp)
+    ref = _ref_greedy(model, params, longer[-126:], 4)
+    assert got == ref, (got, ref)
