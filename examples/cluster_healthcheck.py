@@ -1,0 +1,110 @@
+"""Multi-host cluster health check — the Ray probe script's analog.
+
+The reference validates its Ray cluster with remote CPU/GPU tasks on every
+node plus a Plasma object-store round-trip
+(``Deployment/Ray/scripts/ray_cluster_healthcheck.py:1-80``). The JAX
+equivalent checks the layers that matter here:
+
+1. process rendezvous (``jax.distributed.initialize`` reachable),
+2. every process sees the full global device set,
+3. a compiled all-device collective (psum) returns the exact expected
+   value — proving ICI/DCN paths actually move data,
+4. collective bandwidth estimate from a timed all-gather of a sizeable
+   array (the object-store round-trip analog),
+5. per-device HBM sanity: allocate/compute/fetch on each local device.
+
+Run on every host (single host: just run it):
+``python examples/cluster_healthcheck.py [--coordinator host0:1234
+--process_id N --num_processes M]``. Exit code 0 = healthy.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--mb", type=float, default=32.0,
+                   help="array size for the bandwidth probe")
+    args = p.parse_args()
+
+    from llm_in_practise_tpu.core import dist
+
+    dist.initialize(
+        coordinator_address=args.coordinator,
+        process_id=args.process_id,
+        num_processes=args.num_processes,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ok = True
+    n_local = len(jax.local_devices())
+    n_global = len(jax.devices())
+    print(f"[1] rendezvous: process {jax.process_index()}/{jax.process_count()}")
+    print(f"[2] devices: {n_local} local, {n_global} global "
+          f"({jax.devices()[0].platform})")
+    if n_global < n_local or n_global % max(jax.process_count(), 1):
+        print("    FAIL: global device count inconsistent")
+        ok = False
+
+    # [3] exact collective over every device
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(n_global), ("d",))
+    x = jnp.arange(n_global, dtype=jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("d")))
+
+    @jax.jit
+    def total(v):
+        return v.sum()
+
+    got = float(total(xs))
+    want = n_global * (n_global - 1) / 2
+    status = "ok" if got == want else f"FAIL (got {got}, want {want})"
+    print(f"[3] all-device reduction: {status}")
+    ok = ok and got == want
+
+    # [4] collective bandwidth: timed all-gather of a sharded array
+    if n_global > 1:
+        n_elems = int(args.mb * 2**20 // 4 // n_global * n_global)
+        big = jax.device_put(
+            jnp.ones((n_elems,), jnp.float32), NamedSharding(mesh, P("d")))
+        gather = jax.jit(
+            lambda v: v * 1.0, out_shardings=NamedSharding(mesh, P()))
+        jax.block_until_ready(gather(big))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = gather(big)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / 5
+        gbps = n_elems * 4 * (n_global - 1) / n_global / dt / 1e9
+        print(f"[4] all-gather {args.mb:.0f} MiB over {n_global} devices: "
+              f"{dt * 1e3:.2f} ms (~{gbps:.1f} GB/s per link)")
+    else:
+        print("[4] single device: all-gather skipped")
+
+    # [5] per-local-device HBM round-trip
+    for d in jax.local_devices():
+        a = jax.device_put(jnp.full((256, 256), 3.0), d)
+        val = float((a @ jnp.eye(256)).sum())
+        if val != 3.0 * 256 * 256:
+            print(f"[5] device {d}: FAIL (got {val})")
+            ok = False
+    print(f"[5] per-device compute: {'ok' if ok else 'see failures above'}")
+
+    print("HEALTHY" if ok else "UNHEALTHY")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from llm_in_practise_tpu.serve.gateway import (
     Gateway,
+    PrefixAffinityRouter,
     ResponseCache,
     RetryPolicy,
     Router,
@@ -44,6 +45,10 @@ def main():
     p.add_argument("--no_cache", action="store_true")
     p.add_argument("--moderation", action="store_true",
                    help="enable the pre-call guard hook")
+    p.add_argument("--routing", default="least_pending",
+                   choices=["least_pending", "prefix_aware"],
+                   help="prefix_aware pins conversations to one upstream "
+                        "(llm-d load_aware_prefix parity)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=4000)
     args = p.parse_args()
@@ -68,8 +73,11 @@ def main():
         thr = args.semantic_threshold if args.semantic_threshold > 0 else None
         cache = ResponseCache(ttl_s=args.cache_ttl, semantic_threshold=thr)
 
+    router_cls = (
+        PrefixAffinityRouter if args.routing == "prefix_aware" else Router
+    )
     gw = Gateway(
-        Router(upstreams),
+        router_cls(upstreams),
         retry_policy=RetryPolicy(),
         cache=cache,
         fallbacks=fallbacks,

@@ -22,11 +22,13 @@ from llm_in_practise_tpu.serve.adapters import (  # noqa: F401
 )
 from llm_in_practise_tpu.serve.gateway import (  # noqa: F401
     Gateway,
+    PrefixAffinityRouter,
     ResponseCache,
     RetryPolicy,
     Router,
     Upstream,
 )
+from llm_in_practise_tpu.serve.prefix_cache import PrefixCache  # noqa: F401
 from llm_in_practise_tpu.serve.moderation import (  # noqa: F401
     ModerationService,
     gateway_hook,

@@ -102,6 +102,79 @@ class Router:
             u.served / max(u.weight, 1e-9),
         ))
 
+    def pick_for_request(self, group: str, body: dict,
+                         exclude: set[int] = frozenset()) -> Upstream:
+        """Request-aware pick; the base router ignores the body."""
+        return self.pick(group, exclude=exclude)
+
+
+class PrefixAffinityRouter(Router):
+    """Cache-aware routing — the llm-d ``load_aware_prefix`` strategy
+    (``08-LLM-Router/llm-d/llm-d-config.yaml:20-40``: weighted scoring of
+    pending load vs prefix-cache affinity; nginx consistent-hash on
+    Session-ID is the same idea one layer down).
+
+    Requests from one conversation hash to the same upstream (its prefix
+    KV cache stays hot — see :mod:`.prefix_cache`), unless that upstream
+    is cooled down or the load imbalance outweighs the cache miss cost.
+    """
+
+    def __init__(self, upstreams: list[Upstream], *,
+                 miss_cost: float = 2.0, affinity_ttl_s: float = 600.0,
+                 max_sessions: int = 4096):
+        super().__init__(upstreams)
+        self.miss_cost = miss_cost       # pending-units a cache miss "costs"
+        self.affinity_ttl_s = affinity_ttl_s
+        self.max_sessions = max_sessions
+        # (group, session) -> (ts, upstream id); OrderedDict so eviction is
+        # O(1) LRU instead of a min() scan under the lock. Keyed per group:
+        # a fallback-group pick must not clobber the primary group's pin.
+        from collections import OrderedDict
+
+        self._affinity: "OrderedDict[tuple, tuple[float, int]]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def session_key(body: dict) -> str | None:
+        """Stable conversation identity: the system + first user message
+        (the shared prefix all turns of one chat carry)."""
+        msgs = body.get("messages") or []
+        head = [m for m in msgs if m.get("role") == "system"][:1]
+        head += [m for m in msgs if m.get("role") == "user"][:1]
+        if not head:
+            return None
+        canon = json.dumps(head, sort_keys=True)
+        return hashlib.sha256(canon.encode()).hexdigest()
+
+    def pick_for_request(self, group: str, body: dict,
+                         exclude: set[int] = frozenset()) -> Upstream:
+        session = self.session_key(body)
+        key = (group, session) if session is not None else None
+        cands = [u for u in self.candidates(group) if id(u) not in exclude]
+        if not cands:
+            raise RouterError(f"no available upstream for {group!r}")
+        now = time.time()
+        sticky_id = None
+        if key is not None:
+            with self._lock:
+                hit = self._affinity.get(key)
+                if hit and now - hit[0] < self.affinity_ttl_s:
+                    sticky_id = hit[1]
+
+        def score(u: Upstream) -> tuple:
+            load = (u.pending + 1) / max(u.weight, 1e-9)
+            miss = 0.0 if id(u) == sticky_id else self.miss_cost
+            return (load + miss, u.served / max(u.weight, 1e-9))
+
+        chosen = min(cands, key=score)
+        if key is not None:
+            with self._lock:
+                self._affinity[key] = (now, id(chosen))
+                self._affinity.move_to_end(key)
+                if len(self._affinity) > self.max_sessions:
+                    self._affinity.popitem(last=False)
+        return chosen
+
 
 @dataclass(frozen=True)
 class RetryPolicy:
@@ -344,7 +417,8 @@ class Gateway:
             retriable = True
             while True:
                 try:
-                    upstream = self.router.pick(g, exclude=tried)
+                    upstream = self.router.pick_for_request(
+                        g, body, exclude=tried)
                 except RouterError:
                     break
                 tried.add(id(upstream))

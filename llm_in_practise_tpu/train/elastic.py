@@ -1,0 +1,87 @@
+"""Elastic training supervisor — restart-on-failure with checkpoint resume.
+
+The reference has no elastic training (SURVEY §2.3: torchrun elastic
+unused); its recovery story is "restart the job and resume from
+``latest_checkpoint.pt``" (``temp/ddp_gpt_bpe_tokenizer_02.py:497-498``),
+done by hand. This module automates exactly that loop, the way
+torchrun's ``--max-restarts`` does for the reference's stack:
+
+- :func:`supervise` relaunches a training command on non-zero exit with
+  exponential backoff, up to ``max_restarts`` times. Because every in-tree
+  trainer resumes from its checkpoint directory
+  (``TrainerConfig.resume``), a crash costs at most
+  ``save_every_steps`` of work.
+- A restart *budget window*: exits spaced further apart than
+  ``window_s`` reset the restart counter (long-running jobs shouldn't die
+  because they hit N transient faults over a week).
+
+Use: ``python -m llm_in_practise_tpu.train.elastic --max-restarts 3 --
+python examples/dist_train.py --config ds.json``.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+
+def supervise(
+    argv: list[str],
+    *,
+    max_restarts: int = 3,
+    backoff_s: float = 5.0,
+    window_s: float = 3600.0,
+    _run=subprocess.call,
+    _sleep=time.sleep,
+    _clock=time.monotonic,
+) -> int:
+    """Run ``argv``; restart on failure. Returns the final exit code."""
+    restarts = 0
+    window_start = _clock()
+    attempt = 0
+    while True:
+        attempt += 1
+        start = _clock()
+        code = _run(argv)
+        if code == 0:
+            return 0
+        now = _clock()
+        if now - window_start > window_s:
+            restarts = 0          # healthy for a full window: reset budget
+            window_start = now    # a fresh window starts at this failure —
+            # anchoring at the (old) run start would grant a second free
+            # reset to an immediate crash after one long run
+        if restarts >= max_restarts:
+            print(f"[elastic] giving up after {restarts} restarts "
+                  f"(exit {code})", file=sys.stderr)
+            return code
+        restarts += 1
+        delay = backoff_s * 2 ** (restarts - 1)
+        print(f"[elastic] attempt {attempt} exited {code}; restart "
+              f"{restarts}/{max_restarts} in {delay:.0f}s", file=sys.stderr)
+        _sleep(delay)
+
+
+def main() -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="restart-on-failure supervisor for training commands")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--backoff", type=float, default=5.0)
+    p.add_argument("--window", type=float, default=3600.0)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="-- then the training command")
+    args = p.parse_args()
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given (usage: ... -- python train.py ...)")
+    return supervise(cmd, max_restarts=args.max_restarts,
+                     backoff_s=args.backoff, window_s=args.window)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -360,3 +360,43 @@ def test_streaming_relayed_through_gateway():
             gw.shutdown()
     finally:
         up.close()
+
+
+def test_prefix_affinity_routing(fakes):
+    """Same conversation -> same upstream (cache-aware); new conversations
+    spread; cooldown overrides stickiness."""
+    from llm_in_practise_tpu.serve.gateway import PrefixAffinityRouter
+
+    a, b = fakes("a"), fakes("b")
+    ua = Upstream(a.base_url, "a", group="chat", allowed_fails=1)
+    ub = Upstream(b.base_url, "b", group="chat")
+    gw = Gateway(PrefixAffinityRouter([ua, ub]), health_check_interval_s=0,
+                 retry_policy=RetryPolicy(backoff_s=0.01))
+
+    conv1 = {"model": "chat", "messages": [
+        {"role": "system", "content": "sys A"},
+        {"role": "user", "content": "first"}]}
+    for i in range(3):  # follow-up turns share the prefix
+        turn = dict(conv1)
+        turn["messages"] = conv1["messages"] + [
+            {"role": "assistant", "content": "r"},
+            {"role": "user", "content": f"turn {i}"}]
+        status, _ = gw.handle_completion(turn)
+        assert status == 200
+    first_counts = (a.calls, b.calls)
+    assert sorted(first_counts) == [0, 3]  # all turns pinned to one upstream
+
+    # a second conversation lands on the less-loaded upstream
+    conv2 = {"model": "chat", "messages": [
+        {"role": "system", "content": "sys B"},
+        {"role": "user", "content": "hello"}]}
+    gw.handle_completion(conv2)
+    assert a.calls >= 1 and b.calls >= 1
+
+    # cooldown on the pinned upstream: conversation fails over
+    pinned, other = (a, b) if first_counts[0] == 3 else (b, a)
+    pinned_up = ua if pinned is a else ub
+    pinned_up.cooldown_until = __import__("time").time() + 60
+    other_before = other.calls
+    status, _ = gw.handle_completion(dict(conv1))
+    assert status == 200 and other.calls == other_before + 1

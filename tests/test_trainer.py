@@ -154,3 +154,44 @@ def test_trainer_fsdp_strategy_on_mesh(tmp_path, devices):
     # Params actually sharded over the fsdp axis.
     kernel = trainer.state.params["block_0"]["attn"]["q_proj"]["kernel"]
     assert len(kernel.sharding.device_set) == 8
+
+
+class TestElastic:
+    """Supervisor semantics: restart budget, backoff, window reset."""
+
+    def _driver(self, exit_codes, clock_times=None):
+        from llm_in_practise_tpu.train import elastic
+
+        calls = {"runs": 0, "sleeps": []}
+        codes = list(exit_codes)
+        times = iter(clock_times or [i * 1.0 for i in range(100)])
+
+        def fake_run(argv):
+            calls["runs"] += 1
+            return codes.pop(0)
+
+        code = elastic.supervise(
+            ["cmd"], max_restarts=2, backoff_s=1.0, window_s=100.0,
+            _run=fake_run, _sleep=lambda s: calls["sleeps"].append(s),
+            _clock=lambda: next(times),
+        )
+        return code, calls
+
+    def test_success_first_try(self):
+        code, calls = self._driver([0])
+        assert code == 0 and calls["runs"] == 1
+
+    def test_restarts_then_succeeds(self):
+        code, calls = self._driver([1, 1, 0])
+        assert code == 0 and calls["runs"] == 3
+        assert calls["sleeps"] == [1.0, 2.0]  # exponential backoff
+
+    def test_budget_exhausted(self):
+        code, calls = self._driver([1, 1, 1])
+        assert code == 1 and calls["runs"] == 3  # 1 + 2 restarts
+
+    def test_window_resets_budget(self):
+        # failures spaced > window apart keep restarting
+        times = [0, 10, 200, 210, 500, 510, 900, 910]
+        code, calls = self._driver([1, 1, 1, 0], clock_times=times)
+        assert code == 0 and calls["runs"] == 4
