@@ -13,6 +13,7 @@ from llm_in_practise_tpu.peft.qlora import (
     quantize_base,
 )
 from llm_in_practise_tpu.peft.fused import (
+    fused_quant_apply,
     make_fused_qlora_loss_fn,
     qlora_fused_apply,
 )
@@ -20,6 +21,7 @@ from llm_in_practise_tpu.peft.fused import (
 __all__ = [
     "LoRAConfig",
     "apply_lora",
+    "fused_quant_apply",
     "init_lora",
     "make_fused_qlora_loss_fn",
     "make_qlora_loss_fn",

@@ -14,6 +14,11 @@ so the packed 4-bit weight goes straight into VMEM
 rank-r matmuls (never materializing ΔW), and the bf16 base never exists in
 HBM in either the forward or the backward (base frozen — gradient flows to
 ``x`` and the LoRA factors only). Non-quantized modules run untouched.
+
+The same interceptor serves PTQ exports: Int4Tensor (GPTQ) and AWQTensor
+(AWQ) kernel leaves dispatch to the W4A16 kernel
+(:mod:`llm_in_practise_tpu.ops.int4_matmul`) — :func:`fused_quant_apply`
+is the adapter-free serving entry point.
 """
 
 from __future__ import annotations
@@ -22,10 +27,33 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from llm_in_practise_tpu.ops.int4_matmul import int4_matmul
 from llm_in_practise_tpu.ops.nf4_matmul import nf4_matmul
 from llm_in_practise_tpu.peft import lora as lora_lib
+from llm_in_practise_tpu.quant.awq import AWQTensor
+from llm_in_practise_tpu.quant.int4 import Int4Tensor
 from llm_in_practise_tpu.quant.nf4 import NF4Tensor
 from llm_in_practise_tpu.utils.tree import flatten_with_paths
+
+QUANT_LEAVES = (NF4Tensor, Int4Tensor, AWQTensor)
+
+
+def _is_quant(v) -> bool:
+    return isinstance(v, QUANT_LEAVES)
+
+
+def fused_kernel_matmul(x, t, compute_dtype):
+    """Dispatch one quantized kernel to its fused Pallas matmul.
+
+    AWQ folds its per-input-channel ``inv_scale`` into the activations
+    (``x @ diag(s) @ decode(q) == (x * s) @ decode(q)``), then rides the
+    int4 kernel."""
+    if isinstance(t, NF4Tensor):
+        return nf4_matmul(x, t, compute_dtype)
+    if isinstance(t, AWQTensor):
+        return int4_matmul(
+            x * t.inv_scale.astype(x.dtype), t.q, compute_dtype)
+    return int4_matmul(x, t, compute_dtype)
 
 
 def qlora_fused_apply(
@@ -38,27 +66,30 @@ def qlora_fused_apply(
     **apply_kwargs,
 ):
     """Run ``model.apply`` with quantized Dense kernels served by the fused
-    kernel. ``qparams``: params tree with NF4Tensor kernel leaves (from
-    :func:`..peft.qlora.quantize_base`); ``lora_params``: factor tree from
-    :func:`..peft.lora.init_lora`. Gradients flow through the closure to
-    ``lora_params`` only (the NF4 base is non-differentiable storage)."""
+    kernels. ``qparams``: params tree whose kernel leaves may be NF4Tensor
+    (:func:`..peft.qlora.quantize_base`), Int4Tensor, or AWQTensor (the
+    PTQ exports) — each dispatches to its Pallas matmul via
+    :func:`fused_kernel_matmul`; ``lora_params``: factor tree from
+    :func:`..peft.lora.init_lora` (may be empty — see
+    :func:`fused_quant_apply`). Gradients flow through the closure to
+    ``lora_params`` only (quantized bases are non-differentiable
+    storage)."""
     quant = {
         k: v for k, v in flatten_with_paths(
-            qparams, is_leaf=lambda x: isinstance(x, NF4Tensor)
+            qparams, is_leaf=_is_quant
         ).items()
-        if isinstance(v, NF4Tensor)
+        if _is_quant(v)
     }
     consumed: set[str] = set()
     # init_lora's tree is already keyed by kernel path: {path: {"a", "b"}}
     lora_by_path: dict[str, dict] = lora_params or {}
 
-    # Dense never reads its kernel when intercepted — swap NF4 leaves for
-    # tiny placeholders so the params tree stays a valid array pytree
-    # without materializing the dequantized weight.
+    # Dense never reads its kernel when intercepted — swap quantized
+    # leaves for tiny placeholders so the params tree stays a valid array
+    # pytree without materializing the dequantized weight.
     placeholders = jax.tree_util.tree_map(
-        lambda v: jnp.zeros((1, 1), compute_dtype)
-        if isinstance(v, NF4Tensor) else v,
-        qparams, is_leaf=lambda v: isinstance(v, NF4Tensor),
+        lambda v: jnp.zeros((1, 1), compute_dtype) if _is_quant(v) else v,
+        qparams, is_leaf=_is_quant,
     )
 
     def lora_delta(key, x):
@@ -83,7 +114,7 @@ def qlora_fused_apply(
             delta = lora_delta(key, x)
             return y if delta is None else (y + delta).astype(y.dtype)
         consumed.add(key)
-        y = nf4_matmul(x.astype(compute_dtype), t, compute_dtype)
+        y = fused_kernel_matmul(x.astype(compute_dtype), t, compute_dtype)
         delta = lora_delta(key, x)
         if delta is not None:
             y = y + delta
@@ -96,8 +127,8 @@ def qlora_fused_apply(
         out = model.apply({"params": placeholders}, *args, **apply_kwargs)
     missed = set(quant) - consumed
     if missed:
-        # an unconsumed NF4 leaf means some module computed against its
-        # (1, 1) placeholder — fail loudly at the source
+        # an unconsumed quantized leaf means some module computed against
+        # its (1, 1) placeholder — fail loudly at the source
         raise ValueError(
             "quantized kernels not served by the fused interceptor (module "
             f"is not an nn.Dense?): {sorted(missed)}"
@@ -121,3 +152,14 @@ def make_fused_qlora_loss_fn(model, qparams, cfg: lora_lib.LoRAConfig,
         return base_loss_fn(apply_out, batch, rng)
 
     return loss_fn
+
+
+def fused_quant_apply(model, qtree, *args,
+                      compute_dtype=jnp.bfloat16, **apply_kwargs):
+    """Serve a PTQ-quantized model (Int4/AWQ/NF4 kernel leaves) through the
+    fused kernels — no adapters; the W4A16 serving path
+    (vLLM ``compressed-tensors`` consumption parity)."""
+    return qlora_fused_apply(
+        model, qtree, {}, lora_lib.LoRAConfig(), *args,
+        compute_dtype=compute_dtype, **apply_kwargs,
+    )

@@ -1,0 +1,98 @@
+"""Fused W4A16 int4 matmul kernel (interpret mode — same logic as TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_in_practise_tpu.ops.int4_matmul import _plan, int4_matmul
+from llm_in_practise_tpu.quant import int4
+
+
+def _mk(k, n, gs=64, sym=True, seed=0):
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.normal(0, 0.02, (k, n)), jnp.float32)
+    return int4.rtn_quantize(w, group_size=gs, sym=sym)
+
+
+@pytest.mark.parametrize("m,k,n,gs,sym", [
+    (16, 256, 512, 64, True),
+    (8, 512, 256, 128, False),
+    (5, 128, 128, 32, True),
+])
+def test_forward_matches_decode(m, k, n, gs, sym):
+    t = _mk(k, n, gs, sym)
+    x = jnp.asarray(np.random.default_rng(1).normal(0, 1, (m, k)), jnp.float32)
+    ref = x @ int4.decode(t, jnp.float32)
+    out = int4_matmul(x, t)
+    scale = float(jnp.abs(ref).max())
+    assert float(jnp.abs(out - ref).max()) < 0.02 * max(scale, 1.0)
+
+
+def test_batched_and_backward():
+    t = _mk(256, 384)
+    x = jnp.asarray(np.random.default_rng(2).normal(0, 1, (2, 4, 256)),
+                    jnp.float32)
+    out = int4_matmul(x, t)
+    assert out.shape == (2, 4, 384)
+
+    g = jax.grad(lambda x: jnp.sum(int4_matmul(x, t) ** 2))(x)
+    gref = jax.grad(
+        lambda x: jnp.sum((x @ int4.decode(t, jnp.float32)) ** 2))(x)
+    scale = float(jnp.abs(gref).max())
+    assert float(jnp.abs(g - gref).max()) < 0.02 * max(scale, 1.0)
+    assert g.dtype == x.dtype
+
+
+def test_fallback_for_ragged():
+    t = _mk(96, 64, gs=32)   # K=96: kh=48 not 128-tileable -> fallback
+    assert _plan(t, 8) is None
+    x = jnp.asarray(np.random.default_rng(3).normal(0, 1, (4, 96)),
+                    jnp.float32)
+    out = int4_matmul(x, t)
+    ref = x @ int4.decode(t, jnp.float32)
+    assert float(jnp.abs(out - ref).max()) < 0.05
+
+
+def test_jit_composes():
+    t = _mk(256, 256)
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(int4_matmul(x, t))
+
+    x = jnp.ones((8, 256), jnp.float32)
+    assert np.isfinite(float(f(x)))
+    assert np.isfinite(float(jnp.sum(jax.grad(f)(x))))
+
+
+def test_fused_quant_apply_matches_dequant_tree():
+    """GPTQ/AWQ-quantized model served through the fused kernels must match
+    the dequantize-then-apply path."""
+    import flax.linen as nn
+
+    from llm_in_practise_tpu.models import Qwen3, qwen3_config
+    from llm_in_practise_tpu.peft.fused import fused_quant_apply
+    from llm_in_practise_tpu.quant import AWQConfig, quantize_model_awq
+    from llm_in_practise_tpu.quant.awq import dequantize_tree
+
+    cfg = qwen3_config(128, max_seq_len=64, compute_dtype="float32")
+    model = Qwen3(cfg)
+    x = jnp.asarray(np.random.default_rng(9).integers(0, 128, (2, 16)),
+                    jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x, deterministic=True)["params"]
+    calib = [jnp.asarray(np.random.default_rng(10).integers(0, 128, (1, 16)),
+                         jnp.int32)]
+    qtree = quantize_model_awq(model, params, calib,
+                               AWQConfig(group_size=32, n_grid=4))
+    assert any(
+        not isinstance(v, jax.Array)
+        for v in jax.tree_util.tree_leaves(
+            qtree, is_leaf=lambda v: not isinstance(v, jax.Array))
+    )
+    ref = model.apply({"params": dequantize_tree(qtree, jnp.float32)}, x,
+                      deterministic=True)
+    out = fused_quant_apply(model, qtree, x, compute_dtype=jnp.float32,
+                            deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=0.05, rtol=0.05)
