@@ -46,6 +46,11 @@ def main():
                    action="store_true",
                    help="reuse prompt-prefix KV across requests "
                         "(vLLM APC parity)")
+    p.add_argument("--enable-chunked-prefill", dest="chunked_prefill",
+                   type=int, nargs="?", const=256, default=None,
+                   metavar="CHUNK",
+                   help="prefill long prompts in CHUNK-token steps "
+                        "interleaved with decode (vLLM parity; default 256)")
     args = p.parse_args()
 
     tok = BPETokenizer.load(args.tokenizer_path)
@@ -59,6 +64,7 @@ def main():
         max_slots=args.max_slots, cache_len=args.cache_len,
         eos_id=tok.token_to_id(IM_END), cache_dtype=jnp.float32,
         prefix_cache=args.prefix_caching,
+        chunked_prefill=args.chunked_prefill,
     )
     engine = InferenceEngine(model, params, **engine_kw)
     adapters = {}

@@ -148,6 +148,7 @@ class InferenceEngine:
         prefill_buckets: tuple[int, ...] | None = None,
         rng: jax.Array | None = None,
         prefix_cache: "PrefixCache | bool | None" = None,
+        chunked_prefill: int | None = None,
     ):
         self.model = model
         self.params = params
@@ -168,6 +169,16 @@ class InferenceEngine:
         # Host-side slot table (slot_len mirrors the device cache index so
         # finish checks never force a device sync).
         self.slot_req: list[Request | None] = [None] * max_slots
+        self.slot_ready = np.zeros((max_slots,), bool)
+        # chunked prefill (vLLM enable_chunked_prefill parity): prompts
+        # longer than this many tokens prefill one chunk per engine step,
+        # interleaved with decode so long prompts don't stall active slots.
+        if chunked_prefill is not None and chunked_prefill < 1:
+            raise ValueError(
+                f"chunked_prefill must be >= 1, got {chunked_prefill}"
+            )
+        self.chunked_prefill = chunked_prefill
+        self.slot_prefill: dict[int, dict] = {}
         self.slot_last_token = np.zeros((max_slots,), np.int32)
         self.slot_len = np.zeros((max_slots,), np.int64)
         self.slot_budget = np.zeros((max_slots,), np.int64)  # tokens remaining
@@ -198,6 +209,8 @@ class InferenceEngine:
                                static_argnames=("slot",))
         self._insert_rows = jax.jit(self._insert_rows_fn, donate_argnums=(0,),
                                     static_argnames=("slot",))
+        self._prime = jax.jit(self._prime_fn)
+        self._chunk = jax.jit(self._chunk_fn, donate_argnums=(1,))
 
     # --- jitted pieces -------------------------------------------------------
 
@@ -227,15 +240,9 @@ class InferenceEngine:
         )[:, 0, :]
         return last, cache
 
-    def _prefill_suffix_fn(self, params, prefix_rows, prefix_len,
-                           suffix_ids, suffix_len):
-        """Prefill only the prompt suffix over pre-inserted prefix KV rows.
-
-        ``prefix_rows``: per-layer {key: (1, bucket, ...)}; positions and
-        causal masking follow from the cache index (= prefix_len), so this
-        equals a cold prefill of the full prompt.
-        """
-        cache = self.model.init_cache(1, self.cache_len, dtype=self.cache_dtype)
+    @staticmethod
+    def _primed(cache, prefix_rows, prefix_len):
+        """Fresh 1-slot cache with prefix KV rows inserted, index offset."""
         primed = []
         for layer, rows in zip(cache, prefix_rows):
             new = {"index": jnp.full_like(layer["index"], prefix_len)}
@@ -246,13 +253,47 @@ class InferenceEngine:
                     buf, rows[key].astype(buf.dtype), 0, axis=1
                 )
             primed.append(new)
+        return primed
+
+    def _prefill_suffix_fn(self, params, prefix_rows, prefix_len,
+                           suffix_ids, suffix_len):
+        """Prefill only the prompt suffix over pre-inserted prefix KV rows.
+
+        ``prefix_rows``: per-layer {key: (1, bucket, ...)}; positions and
+        causal masking follow from the cache index (= prefix_len), so this
+        equals a cold prefill of the full prompt.
+        """
+        cache = self.model.init_cache(1, self.cache_len, dtype=self.cache_dtype)
         logits, cache = self.model.apply(
-            {"params": params}, suffix_ids, deterministic=True, cache=primed
+            {"params": params}, suffix_ids, deterministic=True,
+            cache=self._primed(cache, prefix_rows, prefix_len)
         )
         last = jnp.take_along_axis(
             logits, (suffix_len - 1)[None, None, None], axis=1
         )[:, 0, :]
         return last, cache
+
+    def _prime_fn(self, prefix_rows, prefix_len):
+        cache = self.model.init_cache(1, self.cache_len, dtype=self.cache_dtype)
+        return self._primed(cache, prefix_rows, prefix_len)
+
+    def _chunk_fn(self, params, cache, chunk_ids, chunk_len):
+        """One chunked-prefill step: run a fixed-size padded chunk through a
+        1-slot cache; reset the index past the padding to the true length
+        (padding KV beyond it is overwritten by the next chunk and never
+        attended)."""
+        start = cache[0]["index"]
+        logits, cache = self.model.apply(
+            {"params": params}, chunk_ids, deterministic=True, cache=cache
+        )
+        fixed = [
+            dict(layer, index=jnp.full_like(layer["index"], start + chunk_len))
+            for layer in cache
+        ]
+        last = jnp.take_along_axis(
+            logits, (chunk_len - 1)[None, None, None], axis=1
+        )[:, 0, :]
+        return last, fixed
 
     def _insert_fn(self, engine_cache, prefill_cache, slot: int, length):
         """Copy a prefilled request's cache rows into ``slot``."""
@@ -316,56 +357,143 @@ class InferenceEngine:
             except queue.Empty:
                 break
             plen = len(req.prompt_ids)
-            last_logits = self._prefill_into_slot(req, slot, plen)
-            # First generated token comes from the prefill logits.
-            self.rng, sub = jax.random.split(self.rng)
-            first = sample_token_batched(
-                sub, last_logits.astype(jnp.float32),
-                temperature=jnp.asarray([req.params.temperature], jnp.float32),
-                top_k=jnp.asarray([req.params.top_k], jnp.int32),
-                top_p=jnp.asarray([req.params.top_p], jnp.float32),
-                greedy=jnp.asarray([req.params.greedy], bool),
-            )
-            first_id = int(first[0])
-            req.first_token_time = time.monotonic()
-
-            self.slot_req[slot] = req
-            self.slot_last_token[slot] = first_id
-            self.slot_len[slot] = plen
-            self.slot_budget[slot] = req.params.max_tokens - 1
-            self._temperature[slot] = req.params.temperature
-            self._top_k[slot] = req.params.top_k
-            self._top_p[slot] = req.params.top_p
-            self._greedy[slot] = req.params.greedy
+            self._begin_prefill(req, slot, plen)
             admitted = True
-
-            self._emit(slot, first_id)
         with self.stats.lock:
             self.stats.queue_depth = self.pending.qsize()
             self.stats.active_slots = sum(r is not None for r in self.slot_req)
         return admitted
 
-    def _prefill_into_slot(self, req: Request, slot: int, plen: int):
-        """Prefill the prompt (reusing any cached prefix) into ``slot``;
-        returns the last-position logits."""
-        from llm_in_practise_tpu.serve import prefix_cache as pc
+    def _activate(self, slot: int, req: Request, plen: int, last_logits):
+        """Slot bookkeeping once the prompt's KV is in place; samples the
+        first token from the prefill logits."""
+        self.rng, sub = jax.random.split(self.rng)
+        first = sample_token_batched(
+            sub, last_logits.astype(jnp.float32),
+            temperature=jnp.asarray([req.params.temperature], jnp.float32),
+            top_k=jnp.asarray([req.params.top_k], jnp.int32),
+            top_p=jnp.asarray([req.params.top_p], jnp.float32),
+            greedy=jnp.asarray([req.params.greedy], bool),
+        )
+        first_id = int(first[0])
+        req.first_token_time = time.monotonic()
 
+        self.slot_req[slot] = req
+        self.slot_ready[slot] = True
+        self.slot_last_token[slot] = first_id
+        self.slot_len[slot] = plen
+        self.slot_budget[slot] = req.params.max_tokens - 1
+        self._temperature[slot] = req.params.temperature
+        self._top_k[slot] = req.params.top_k
+        self._top_p[slot] = req.params.top_p
+        self._greedy[slot] = req.params.greedy
+        self._emit(slot, first_id)
+
+    def _chunk_span(self, rem: int) -> int:
+        """Padded length the chunked path would write for ``rem`` tokens."""
+        c = self.chunked_prefill
+        return -(-rem // c) * c
+
+    def _oneshot_fits(self, done: int, rem: int) -> bool:
+        return done + self._bucket_for(rem) <= self.cache_len
+
+    def _chunked_fits(self, done: int, rem: int) -> bool:
+        return (self.chunked_prefill is not None
+                and done + self._chunk_span(rem) <= self.cache_len)
+
+    def _lookup_prefix(self, req: Request, plen: int):
         def usable(entry) -> bool:
-            # the suffix's padded bucket must land inside cache_len, or the
-            # scatter would clamp and corrupt the prefix KV
+            # every padded write the remaining prefill would do must land
+            # inside cache_len, or the scatter clamps and corrupts the
+            # prefix KV — either the one-shot bucket or the chunk span fits
             if entry.length == plen:
                 return True
-            sbucket = self._bucket_for(plen - entry.length)
-            return entry.length + sbucket <= self.cache_len
+            rem = plen - entry.length
+            return (self._oneshot_fits(entry.length, rem)
+                    or self._chunked_fits(entry.length, rem))
 
-        hit = (self.prefix_cache.lookup(req.prompt_ids, usable)
-               if self.prefix_cache is not None else None)
+        if self.prefix_cache is None:
+            return None
+        return self.prefix_cache.lookup(req.prompt_ids, usable)
+
+    def _begin_prefill(self, req: Request, slot: int, plen: int) -> None:
+        """Route one admitted request: full prefix hit → direct insert;
+        long remainder (chunked prefill on) → incremental, one chunk per
+        engine step so running slots keep decoding; otherwise one-shot."""
+        hit = self._lookup_prefix(req, plen)
         if hit is not None and hit.length == plen:
-            # full-prompt hit: no prefill at all
             self.cache = self._insert_rows(
                 self.cache, hit.rows, slot, jnp.asarray(plen, jnp.int32))
-            return hit.last_logits
+            self._activate(slot, req, plen, hit.last_logits)
+            return
+        done = hit.length if hit is not None else 0
+        rem = plen - done
+        # chunked when the remainder is long (the point of interleaving) OR
+        # when only the chunk span fits the cache; a hit that fits neither
+        # way was already filtered by _lookup_prefix's usable().
+        chunk_it = self._chunked_fits(done, rem) and (
+            rem > self.chunked_prefill or not self._oneshot_fits(done, rem)
+        )
+        if chunk_it:
+            mini = (
+                self._prime(hit.rows, jnp.asarray(done, jnp.int32))
+                if hit is not None
+                else self.model.init_cache(1, self.cache_len,
+                                           dtype=self.cache_dtype)
+            )
+            self.slot_req[slot] = req   # slot reserved, not yet decodable
+            self.slot_ready[slot] = False
+            self.slot_prefill[slot] = {"req": req, "plen": plen, "done": done,
+                                       "cache": mini, "last_logits": None}
+            return
+        last_logits = self._prefill_into_slot(req, slot, plen, hit)
+        self._activate(slot, req, plen, last_logits)
 
+    def _advance_prefills(self, budget: int = 1) -> bool:
+        """Run up to ``budget`` prefill chunks; finalize finished prompts."""
+        progressed = False
+        for slot in list(self.slot_prefill):
+            if budget <= 0:
+                break
+            st = self.slot_prefill[slot]
+            req, plen = st["req"], st["plen"]
+            chunk = req.prompt_ids[st["done"]: st["done"] + self.chunked_prefill]
+            padded = np.zeros((1, self.chunked_prefill), np.int32)
+            padded[0, :len(chunk)] = chunk
+            st["last_logits"], st["cache"] = self._chunk(
+                self.params, st["cache"], jnp.asarray(padded),
+                jnp.asarray(len(chunk), jnp.int32),
+            )
+            st["done"] += len(chunk)
+            budget -= 1
+            progressed = True
+            if st["done"] >= plen:
+                del self.slot_prefill[slot]
+                self._finish_prefill(req, slot, plen, st["cache"],
+                                     st["last_logits"])
+                self._activate(slot, req, plen, st["last_logits"])
+        return progressed
+
+    def _finish_prefill(self, req: Request, slot: int, plen: int,
+                        pre_cache, last_logits) -> None:
+        """Store the finished prompt's prefix entry and move its KV rows
+        into the slot — shared tail of both prefill paths."""
+        from llm_in_practise_tpu.serve import prefix_cache as pc
+
+        if self.prefix_cache is not None:
+            bucket = self._bucket_for(plen)
+            self.prefix_cache.put(req.prompt_ids, pc.PrefixEntry(
+                length=plen, bucket=bucket,
+                rows=pc.slice_cache_rows(pre_cache, bucket),
+                last_logits=last_logits,
+            ))
+        self.cache = self._insert(
+            self.cache, pre_cache, slot, jnp.asarray(plen, jnp.int32)
+        )
+
+    def _prefill_into_slot(self, req: Request, slot: int, plen: int, hit):
+        """One-shot prefill (reusing any cached prefix rows) into ``slot``;
+        returns the last-position logits."""
         if hit is not None:
             suffix = req.prompt_ids[hit.length:]
             sbucket = self._bucket_for(len(suffix))
@@ -382,15 +510,7 @@ class InferenceEngine:
             last_logits, pre_cache = self._prefill(
                 self.params, jnp.asarray(padded), jnp.asarray(plen, jnp.int32)
             )
-        if self.prefix_cache is not None and (hit is None or hit.length < plen):
-            self.prefix_cache.put(req.prompt_ids, pc.PrefixEntry(
-                length=plen, bucket=self._bucket_for(plen),
-                rows=pc.slice_cache_rows(pre_cache, self._bucket_for(plen)),
-                last_logits=last_logits,
-            ))
-        self.cache = self._insert(
-            self.cache, pre_cache, slot, jnp.asarray(plen, jnp.int32)
-        )
+        self._finish_prefill(req, slot, plen, pre_cache, last_logits)
         return last_logits
 
     def _emit(self, slot: int, token_id: int):
@@ -410,15 +530,18 @@ class InferenceEngine:
             req.tokens.put(_FINISH)
             self.stats.observe_finished(req)
             self.slot_req[slot] = None
+            self.slot_ready[slot] = False
             self.slot_budget[slot] = 0
 
     def step(self) -> bool:
         """One engine iteration. Returns False when fully idle."""
         with self._lock:
             self._admit()
-            active = [s for s, r in enumerate(self.slot_req) if r is not None]
+            progressed = self._advance_prefills()
+            active = [s for s, r in enumerate(self.slot_req)
+                      if r is not None and self.slot_ready[s]]
             if not active:
-                return False
+                return progressed or bool(self.slot_prefill)
             self.rng, sub = jax.random.split(self.rng)
             next_tok, self.cache = self._decode(
                 self.params, self.cache,

@@ -192,3 +192,77 @@ def test_prefix_cache_overflow_falls_back_to_cold(rng):
     got = engine.generate(longer, sp)
     ref = _ref_greedy(model, params, longer[-126:], 4)
     assert got == ref, (got, ref)
+
+
+def test_chunked_prefill_matches_oneshot(rng):
+    """enable_chunked_prefill parity: chunked prompt ingestion must produce
+    identical greedy outputs, also when combined with the prefix cache."""
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+    model, params = _tiny_model(rng)
+    sp = SamplingParams(greedy=True, max_tokens=6)
+    prompt = [(i * 7) % 60 + 1 for i in range(50)]
+
+    baseline = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32)
+    ref = baseline.generate(prompt, sp)
+
+    chunked = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        chunked_prefill=16)
+    got = chunked.generate(prompt, sp)
+    assert got == ref, (got, ref)
+
+    # chunked + prefix cache: extension of a cached prompt, still exact
+    pc = PrefixCache(min_prefix=8)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        chunked_prefill=16, prefix_cache=pc)
+    assert engine.generate(prompt, sp) == ref
+    longer = prompt + [(i * 3) % 60 + 1 for i in range(40)]
+    got_ext = engine.generate(longer, sp)
+    ref_ext = _ref_greedy(model, params, longer, 6)
+    assert got_ext == ref_ext, (got_ext, ref_ext)
+    assert pc.hits >= 1
+
+
+def test_chunked_prefill_interleaves_with_decode(rng):
+    """While a long prompt chunk-prefills, an already-running request keeps
+    producing tokens (the whole point of chunked prefill)."""
+    model, params = _tiny_model(rng)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        chunked_prefill=8)
+    short = engine.submit([1, 2, 3], SamplingParams(greedy=True, max_tokens=30))
+    engine.step()  # admits + starts decoding the short request
+    long_prompt = [(i % 60) + 1 for i in range(64)]
+    long_req = engine.submit(long_prompt,
+                             SamplingParams(greedy=True, max_tokens=4))
+    for _ in range(4):  # chunks of 8 over 64 tokens: still prefilling
+        engine.step()
+    assert short.n_generated > 1          # decode progressed during prefill
+    assert long_req.first_token_time is None  # long prompt not done yet
+    while engine.step():
+        pass
+    assert long_req.finish_reason is not None
+    assert _ref_greedy(model, params, long_prompt, 4) == list(long_req)
+
+
+def test_chunked_prefill_overflow_safe(rng):
+    """Misaligned chunk sizes whose padded span would cross cache_len must
+    fall back to one-shot prefill, not clamp-corrupt the KV."""
+    import pytest
+
+    model, params = _tiny_model(rng)
+    sp = SamplingParams(greedy=True, max_tokens=4)
+    # chunk 48 over a 126-token prompt: span ceil(126/48)*48 = 144 > 128
+    engine = InferenceEngine(
+        model, params, max_slots=1, cache_len=128, cache_dtype=jnp.float32,
+        chunked_prefill=48)
+    prompt = [(i % 60) + 1 for i in range(126)]
+    got = engine.generate(prompt, sp)
+    assert got == _ref_greedy(model, params, prompt, 4)
+
+    with pytest.raises(ValueError, match="chunked_prefill"):
+        InferenceEngine(model, params, max_slots=1, cache_len=128,
+                        chunked_prefill=0)
