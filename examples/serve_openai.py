@@ -51,6 +51,9 @@ def main():
                    metavar="CHUNK",
                    help="prefill long prompts in CHUNK-token steps "
                         "interleaved with decode (vLLM parity; default 256)")
+    p.add_argument("--tensor-parallel-size", dest="tp", type=int, default=1,
+                   help="shard the model over N devices for serving "
+                        "(vLLM --tensor-parallel-size parity)")
     args = p.parse_args()
 
     tok = BPETokenizer.load(args.tokenizer_path)
@@ -60,11 +63,23 @@ def main():
 
     from llm_in_practise_tpu.data.sft import IM_END
 
+    mesh = None
+    shard_fn = None
+    if args.tp > 1:
+        from llm_in_practise_tpu.parallel import strategy as S
+        from llm_in_practise_tpu.serve.engine import shard_params_for_serving
+
+        strat = S.tensor_parallel(model=args.tp, data=1)
+        mesh = strat.build_mesh(jax.devices()[: args.tp])
+        shard_fn = lambda p: shard_params_for_serving(p, strat, mesh)
+        params = shard_fn(params)
+        print(f"tensor parallel over {args.tp} devices")
+
     engine_kw = dict(
         max_slots=args.max_slots, cache_len=args.cache_len,
         eos_id=tok.token_to_id(IM_END), cache_dtype=jnp.float32,
         prefix_cache=args.prefix_caching,
-        chunked_prefill=args.chunked_prefill,
+        chunked_prefill=args.chunked_prefill, mesh=mesh,
     )
     engine = InferenceEngine(model, params, **engine_kw)
     adapters = {}
@@ -75,7 +90,8 @@ def main():
         )
 
         adapters = build_adapter_engines(
-            model, params, parse_lora_modules(args.lora_modules), **engine_kw
+            model, params, parse_lora_modules(args.lora_modules),
+            param_transform=shard_fn, **engine_kw
         )
         print(f"adapters: {sorted(adapters)}")
     server = OpenAIServer(engine, tok, model_name=args.model_name,

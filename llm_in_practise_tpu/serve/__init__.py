@@ -13,6 +13,7 @@ from llm_in_practise_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
     Request,
     SamplingParams,
+    shard_params_for_serving,
 )
 from llm_in_practise_tpu.serve.api import OpenAIServer, build_prompt  # noqa: F401
 from llm_in_practise_tpu.serve.adapters import (  # noqa: F401

@@ -50,10 +50,21 @@ def build_adapter_engines(
     model,
     base_params,
     modules: dict[str, str],
+    param_transform=None,
     **engine_kw,
 ) -> dict[str, InferenceEngine]:
-    """One engine per adapter name, merged weights, shared model/config."""
+    """One engine per adapter name, merged weights, shared model/config.
+
+    ``param_transform`` (optional) post-processes each adapter's merged
+    params — e.g. :func:`..serve.engine.shard_params_for_serving` so
+    adapters follow the base engine's tensor-parallel placement instead of
+    replicating host arrays onto every mesh device.
+    """
+    def prep(path):
+        merged = load_adapter(base_params, path)
+        return param_transform(merged) if param_transform else merged
+
     return {
-        name: InferenceEngine(model, load_adapter(base_params, path), **engine_kw)
+        name: InferenceEngine(model, prep(path), **engine_kw)
         for name, path in modules.items()
     }

@@ -149,9 +149,16 @@ class InferenceEngine:
         rng: jax.Array | None = None,
         prefix_cache: "PrefixCache | bool | None" = None,
         chunked_prefill: int | None = None,
+        mesh=None,
     ):
         self.model = model
         self.params = params
+        # Tensor-parallel serving (vLLM --tensor-parallel-size parity):
+        # pass a mesh and params already placed by
+        # :func:`shard_params_for_serving`; the KV cache shards its heads
+        # dim over the mesh's ``model`` axis and XLA compiles the
+        # activation collectives into the same decode/prefill programs.
+        self.mesh = mesh
         self.max_slots = max_slots
         limit = max_positions(getattr(model, "config", None))
         self.cache_len = min(cache_len, limit) if limit else cache_len
@@ -165,6 +172,8 @@ class InferenceEngine:
 
         self.cache = model.init_cache(max_slots, self.cache_len, dtype=cache_dtype)
         self._vectorize_cache_index()
+        if mesh is not None:
+            self.cache = jax.device_put(self.cache, self._cache_shardings())
 
         # Host-side slot table (slot_len mirrors the device cache index so
         # finish checks never force a device sync).
@@ -218,6 +227,23 @@ class InferenceEngine:
         """Scalar per-layer cache index -> (max_slots,) vector."""
         for layer in self.cache:
             layer["index"] = jnp.zeros((self.max_slots,), jnp.int32)
+
+    def _cache_shardings(self):
+        """KV heads ('k'/'v' buffers, dim 2) shard over the ``model`` axis;
+        everything else (latent MLA 'kv' buffers, indices) replicates."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from llm_in_practise_tpu.utils.tree import path_str
+
+        tp = self.mesh.shape.get("model", 1)
+
+        def leaf(path, x):
+            key = path_str(path).rsplit("/", 1)[-1]
+            if key in ("k", "v") and tp > 1 and x.shape[2] % tp == 0:
+                return NamedSharding(self.mesh, P(None, None, "model", None))
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree_util.tree_map_with_path(leaf, self.cache)
 
     def _decode_fn(self, params, cache, tokens, rng, temperature, top_k, top_p, greedy):
         logits, cache = self.model.apply(
@@ -589,3 +615,10 @@ class InferenceEngine:
             while self.step():
                 pass
         return req.result()
+
+
+def shard_params_for_serving(params, strategy, mesh):
+    """Place model params for sharded serving (TP/FSDP over ``mesh``) —
+    the loading step vLLM does per tensor-parallel rank, here one
+    device_put against the strategy's NamedShardings."""
+    return jax.device_put(params, strategy.param_shardings(params, mesh))
