@@ -67,6 +67,7 @@ class GPT(nn.Module):
         deterministic: bool = True,
         cache: list[layers.Cache] | None = None,
         positions: jax.Array | None = None,
+        return_hidden: bool = False,
     ):
         cfg = self.config
         b, l = idx.shape
@@ -108,6 +109,11 @@ class GPT(nn.Module):
                 new_cache.append(layer_cache)
 
         x = nn.LayerNorm(name="ln_f")(x.astype(jnp.float32))
+        if return_hidden:
+            # trunk output for downstream heads (classification fine-tunes —
+            # the HF_Basics sequence-classification demos); the LM head's
+            # params are simply never created in this configuration
+            return (x, new_cache) if cache is not None else x
         if cfg.tie_weights:
             logits = embed.attend(x)
         else:

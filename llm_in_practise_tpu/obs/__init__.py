@@ -7,6 +7,12 @@ lacks; serving-side Prometheus metrics live with the server in
 """
 
 from llm_in_practise_tpu.obs.logging import get_logger, setup_logging  # noqa: F401
+from llm_in_practise_tpu.obs.debug import (  # noqa: F401
+    disable_debug,
+    enable_debug,
+    seed_everything,
+    tap,
+)
 from llm_in_practise_tpu.obs.meter import (  # noqa: F401
     EpochTimer,
     RollingMean,

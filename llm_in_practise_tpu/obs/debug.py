@@ -1,0 +1,76 @@
+"""Debug aids: NaN trapping, determinism, per-process seeding.
+
+SURVEY §5.2 — the reference ships no sanitizers (no ``detect_anomaly``,
+no TSAN); its closest tools are deterministic per-rank seeds
+(``temp/ddp_gpt_bpe_tokenizer_02.py:444-447``) and doc-level OOM
+troubleshooting. This module supplies the missing debug plane for the JAX
+stack:
+
+- :func:`enable_debug` — trap NaNs/Infs at the op that produces them
+  (``jax.debug_nans``; the ``torch.autograd.detect_anomaly`` analog) and
+  optionally disable jit so Python tracebacks point at source lines.
+- :func:`seed_everything` — one seed, folded per process (the reference's
+  ``seed + rank``): returns the process-local PRNGKey and seeds numpy.
+- :func:`tap` — print a traced value from inside jit without breaking
+  compilation (``jax.debug.print`` wrapper with a label).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+_FLAGS = ("jax_debug_nans", "jax_debug_infs", "jax_disable_jit")
+_saved: dict[str, bool] | None = None
+
+
+def enable_debug(*, nans: bool = True, infs: bool = False,
+                 disable_jit: bool = False) -> None:
+    """Turn on op-level NaN trapping (and optionally Inf trapping / eager
+    mode).
+
+    With ``nans``, any op producing a NaN raises immediately with the
+    offending primitive — inside jit the function re-runs op-by-op to
+    locate it. ``infs`` is separate (off by default): inf is a legitimate
+    sentinel in parts of this codebase (top-p sampling masks with ``inf``),
+    so trapping it produces false positives on correct inference code.
+    ``disable_jit`` makes every step eager so stack traces map directly to
+    Python lines (slow; debugging only).
+    """
+    global _saved
+    if _saved is None:  # remember the pre-debug configuration once
+        _saved = {f: bool(getattr(jax.config, f)) for f in _FLAGS}
+    if nans:
+        jax.config.update("jax_debug_nans", True)
+    if infs:
+        jax.config.update("jax_debug_infs", True)
+    if disable_jit:
+        jax.config.update("jax_disable_jit", True)
+
+
+def disable_debug() -> None:
+    """Restore the configuration from before the first enable_debug call
+    (a user-set JAX_DISABLE_JIT etc. survives the debug session)."""
+    global _saved
+    if _saved is None:
+        return
+    for flag, value in _saved.items():
+        jax.config.update(flag, value)
+    _saved = None
+
+
+def seed_everything(seed: int) -> jax.Array:
+    """Deterministic per-process seeding (reference ``seed + rank``):
+    seeds numpy's global RNG with ``seed + process_index`` and returns the
+    process-local JAX PRNGKey (fold_in keeps streams independent)."""
+    idx = jax.process_index()
+    np.random.seed((seed + idx) % 2**32)
+    return jax.random.fold_in(jax.random.PRNGKey(seed), idx)
+
+
+def tap(value, label: str = "tap"):
+    """Print a traced value from inside a jitted function; returns it
+    unchanged so it drops into existing expressions."""
+    jax.debug.print("{label}: {v}", label=label, v=value)
+    return value

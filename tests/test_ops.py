@@ -129,3 +129,30 @@ def test_sampling_greedy_and_topk():
     for seed in range(5):
         tok = sample_token(jax.random.PRNGKey(seed), logits, top_p=0.01)
         assert int(tok[0]) == 1
+
+
+class TestDebug:
+    def test_seed_everything_deterministic(self):
+        from llm_in_practise_tpu.obs.debug import seed_everything
+
+        k1 = seed_everything(42)
+        k2 = seed_everything(42)
+        assert (np.asarray(k1) == np.asarray(k2)).all()
+        assert not (np.asarray(seed_everything(7)) == np.asarray(k1)).all()
+
+    def test_nan_trap_raises_and_resets(self):
+        import jax
+        import pytest
+
+        from llm_in_practise_tpu.obs.debug import disable_debug, enable_debug
+
+        enable_debug(nans=True)
+        try:
+            with pytest.raises(FloatingPointError):
+                jax.block_until_ready(
+                    jnp.log(jnp.zeros(4)) - jnp.log(jnp.zeros(4)))
+        finally:
+            disable_debug()
+        # traps off again: the same expression just yields nan
+        out = jnp.log(jnp.zeros(4)) - jnp.log(jnp.zeros(4))
+        assert bool(jnp.isnan(out).all())
