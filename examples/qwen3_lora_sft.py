@@ -80,15 +80,18 @@ def main():
     args = p.parse_args()
 
     records = self_cognition_records(n=64)
-    tok = build_tokenizer(records, args.name, args.author, args.tokenizer_path)
-
     if args.model_dir:
+        # real checkpoint: its own tokenizer (AutoTokenizer parity) + weights
+        from llm_in_practise_tpu.data import HFTokenizerAdapter
         from llm_in_practise_tpu.models import hf_loader
 
+        tok = HFTokenizerAdapter.from_pretrained(args.model_dir)
         cfg = hf_loader.load_config(args.model_dir)
         model = Qwen3(cfg)
         params = hf_loader.load_qwen3(args.model_dir)[1]
     else:
+        tok = build_tokenizer(records, args.name, args.author,
+                              args.tokenizer_path)
         cfg = qwen3_config(tok.vocab_size, max_seq_len=args.max_length,
                            compute_dtype="float32")
         model = Qwen3(cfg)

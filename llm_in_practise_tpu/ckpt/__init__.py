@@ -1,0 +1,9 @@
+"""Checkpointing: portable msgpack tier + Orbax sharded/async tier."""
+
+from llm_in_practise_tpu.ckpt.checkpoint import (  # noqa: F401
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+    save_named,
+)
+from llm_in_practise_tpu.ckpt.sharded import ShardedCheckpointer  # noqa: F401

@@ -1,0 +1,83 @@
+"""Sharded/async checkpointing via Orbax — the distributed tier.
+
+SURVEY §5.4 tier 4: the reference gathers full state to rank 0 (FSDP
+``get_state_dict(full_state_dict=True)`` — ``fsdp_gpt_wikitext2.py:
+357-367``) or saves DeepSpeed engine shards (``engine.save_checkpoint``).
+The msgpack tier in :mod:`.checkpoint` is the gather-to-coordinator
+equivalent; this module is the TPU-native distributed tier it points to:
+
+- **Sharded**: every process writes its own param shards (no
+  gather-to-rank-0 host OOM for 14B models on an FSDP mesh).
+- **Async**: `save` returns once the on-device arrays are snapshotted;
+  serialization overlaps the next training steps
+  (``AsyncCheckpointer``).
+- **Resume into placement**: restore takes the target sharded pytree and
+  materializes each shard directly onto its devices.
+- **Rotation + step tracking** via ``CheckpointManager`` (keep-last-N, the
+  reference's rotating checkpoints — ``DeepSeekLike_spare_MoE…:550-572``).
+
+Use for multi-host / large-model runs; the msgpack tier remains the
+simple portable format for everything else.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+class ShardedCheckpointer:
+    """Rotating, async, sharded train-state checkpoints."""
+
+    def __init__(self, directory: str, *, keep: int = 5,
+                 async_save: bool = True):
+        ocp = _ocp()
+        self._manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state) -> bool:
+        """Snapshot ``state`` (any pytree of — possibly sharded — arrays)
+        at ``step``; returns whether a save was performed. Async: returns
+        as soon as device arrays are copied; disk I/O overlaps training."""
+        ocp = _ocp()
+        return self._manager.save(
+            int(step), args=ocp.args.StandardSave(state))
+
+    def restore(self, target, step: int | None = None):
+        """Restore into ``target``'s structure *and sharding*: pass the
+        freshly initialized (sharded) state; each process reads only its
+        shards. ``step=None`` -> latest."""
+        ocp = _ocp()
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array) else x,
+            target,
+        )
+        return self._manager.restore(
+            int(step), args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> int | None:
+        return self._manager.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._manager.all_steps())
+
+    def wait(self) -> None:
+        """Block until pending async saves hit disk (call before exit)."""
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.close()

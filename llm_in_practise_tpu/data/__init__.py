@@ -1,5 +1,6 @@
 from llm_in_practise_tpu.data.bpe import BPETokenizer, train_or_load
 from llm_in_practise_tpu.data.chardata import CharTokenizer, char_lm_examples
+from llm_in_practise_tpu.data.hf_tokenizer import HFTokenizerAdapter
 from llm_in_practise_tpu.data.lm_dataset import (
     block_chunk,
     prepare_data,
@@ -20,6 +21,7 @@ from llm_in_practise_tpu.data.sft import (
 __all__ = [
     "BPETokenizer",
     "CharTokenizer",
+    "HFTokenizerAdapter",
     "IGNORE_INDEX",
     "SFTBatch",
     "batch_iterator",

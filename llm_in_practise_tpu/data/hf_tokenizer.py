@@ -1,0 +1,63 @@
+"""Adapter: Hugging Face tokenizers → the in-tree tokenizer protocol.
+
+The in-tree BPE (:mod:`.bpe`) covers training-from-scratch; *pretrained*
+checkpoints (Qwen3, DeepSeek-R1 — loaded by ``models/hf_loader``) ship
+their own tokenizer.json, and re-deriving those merges would change the
+vocabulary. This adapter wraps the checkpoint's own tokenizer behind the
+exact protocol every consumer here expects (``encode``/``decode``/
+``token_to_id``/``pad_id``/``vocab_size``), so the SFT pipeline, the
+serving stack, and the examples take either tokenizer interchangeably —
+the reference's ``AutoTokenizer.from_pretrained`` step
+(``Fine-Tuning/qwen3-8b-lora.py:110``), one seam over.
+"""
+
+from __future__ import annotations
+
+
+class HFTokenizerAdapter:
+    """Wraps a ``transformers`` tokenizer (slow or fast). For a raw
+    ``tokenizers.Tokenizer``, wrap it in
+    ``transformers.PreTrainedTokenizerFast(tokenizer_object=...)`` first —
+    the adapter relies on the transformers method surface."""
+
+    def __init__(self, hf_tokenizer):
+        self._tok = hf_tokenizer
+
+    @classmethod
+    def from_pretrained(cls, model_dir: str) -> "HFTokenizerAdapter":
+        """Load the checkpoint's own tokenizer (local files only — model
+        dirs arrive via the preloader, not the hub)."""
+        from transformers import AutoTokenizer
+
+        return cls(AutoTokenizer.from_pretrained(
+            model_dir, local_files_only=True))
+
+    # --- the in-tree protocol -------------------------------------------------
+
+    def encode(self, text: str, *, add_special_tokens: bool = False) -> list[int]:
+        return list(self._tok.encode(text, add_special_tokens=add_special_tokens))
+
+    def decode(self, ids, *, skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(map(int, ids)),
+                                skip_special_tokens=skip_special_tokens)
+
+    def token_to_id(self, token: str) -> int | None:
+        tid = self._tok.convert_tokens_to_ids(token)
+        unk = getattr(self._tok, "unk_token_id", None)
+        if tid is None or (unk is not None and tid == unk and token != self._tok.unk_token):
+            return None
+        return int(tid)
+
+    @property
+    def pad_id(self) -> int:
+        pid = getattr(self._tok, "pad_token_id", None)
+        if pid is None:
+            pid = getattr(self._tok, "eos_token_id", None)
+        return int(pid) if pid is not None else 0
+
+    @property
+    def vocab_size(self) -> int:
+        return int(len(self._tok))
+
+    def get_vocab_size(self) -> int:
+        return self.vocab_size

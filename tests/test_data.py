@@ -187,3 +187,35 @@ class TestConverters:
 
         msgs = alpaca_to_messages(out[0], system_prompt="sys")
         assert [m["role"] for m in msgs] == ["system", "user", "assistant"]
+
+
+class TestHFTokenizerAdapter:
+    def _adapter(self):
+        from tokenizers import Tokenizer, models, pre_tokenizers
+        from transformers import PreTrainedTokenizerFast
+
+        from llm_in_practise_tpu.data.hf_tokenizer import HFTokenizerAdapter
+
+        vocab = {"[PAD]": 0, "[UNK]": 1, "hello": 2, "world": 3,
+                 "h": 4, "w": 5, "o": 6}
+        tok = Tokenizer(models.WordLevel(vocab=vocab, unk_token="[UNK]"))
+        tok.pre_tokenizer = pre_tokenizers.Whitespace()
+        fast = PreTrainedTokenizerFast(
+            tokenizer_object=tok, pad_token="[PAD]", unk_token="[UNK]")
+        return HFTokenizerAdapter(fast)
+
+    def test_protocol(self):
+        ad = self._adapter()
+        ids = ad.encode("hello world")
+        assert ids == [2, 3]
+        assert ad.decode(ids) == "hello world"
+        assert ad.token_to_id("hello") == 2
+        assert ad.token_to_id("not-a-token") is None
+        assert ad.pad_id == 0
+        assert ad.vocab_size == 7 and ad.get_vocab_size() == 7
+
+    def test_sft_pipeline_accepts_adapter(self):
+        ad = self._adapter()
+        batch = tokenize_for_sft(["hello world"], ad, max_length=8)
+        assert batch.input_ids.shape == (1, 8)
+        assert batch.input_ids[0, 0] == 2
