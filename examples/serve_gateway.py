@@ -49,6 +49,14 @@ def main():
                    choices=["least_pending", "prefix_aware"],
                    help="prefix_aware pins conversations to one upstream "
                         "(llm-d load_aware_prefix parity)")
+    p.add_argument("--standby", action="append", default=[],
+                   metavar="GROUP=URL[|MODEL]",
+                   help="repeatable: replicas the autoscaler may bring into "
+                        "rotation (Ray Serve autoscaling_config parity)")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX:TARGET",
+                   help="scale each group between MIN and MAX replicas "
+                        "toward TARGET ongoing requests per replica "
+                        "(requires --standby capacity above MIN)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=4000)
     args = p.parse_args()
@@ -83,10 +91,51 @@ def main():
         fallbacks=fallbacks,
         moderation=gateway_hook(ModerationService()) if args.moderation else None,
     )
+    scalers = []
+    if args.autoscale:
+        from llm_in_practise_tpu.serve.autoscale import (
+            AutoscaleConfig, ReplicaAutoscaler,
+        )
+
+        lo, hi, target = args.autoscale.split(":")
+        cfg = AutoscaleConfig(min_replicas=int(lo), max_replicas=int(hi),
+                              target_ongoing_requests=float(target),
+                              upscale_delay_s=10.0, downscale_delay_s=60.0)
+        standby: dict[str, list[Upstream]] = {}
+        for spec in args.standby:
+            group, _, rest = spec.partition("=")
+            url, _, model = rest.partition("|")
+            standby.setdefault(group, []).append(Upstream(
+                url.rstrip("/"), model=model or group, group=group))
+        # every group that has initial OR standby capacity gets a scaler
+        for group in sorted(set(gw.router.groups()) | set(standby)):
+            pool = standby.get(group, [])
+
+            def spawn(pool=pool, group=group):
+                if not pool:
+                    raise RuntimeError(f"no standby capacity for {group!r}")
+                u = pool.pop()
+                print(f"autoscale: +{group} -> {u.base_url}")
+                return u
+
+            def stop(u, pool=pool):
+                print(f"autoscale: -{u.group} -> {u.base_url}")
+                pool.append(u)
+
+            scalers.append(ReplicaAutoscaler(
+                gw.router, group, spawn=spawn, stop=stop, config=cfg,
+            ).start())
+        print(f"autoscaler: {args.autoscale} over "
+              f"{sum(len(v) for v in standby.values())} standby replicas")
+
     for u in upstreams:
         print(f"upstream {u.group}: {u.base_url} (weight {u.weight})")
     print(f"gateway on {args.host}:{args.port}")
-    gw.serve(host=args.host, port=args.port)
+    try:
+        gw.serve(host=args.host, port=args.port)
+    finally:
+        for s in scalers:
+            s.shutdown()
 
 
 if __name__ == "__main__":

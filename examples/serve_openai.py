@@ -54,6 +54,14 @@ def main():
     p.add_argument("--tensor-parallel-size", dest="tp", type=int, default=1,
                    help="shard the model over N devices for serving "
                         "(vLLM --tensor-parallel-size parity)")
+    p.add_argument("--kv-offload", dest="kv_offload", action="store_true",
+                   help="tiered KV: offload evicted/finished prefix KV to "
+                        "host RAM and re-hit it (LMCache local-CPU parity)")
+    p.add_argument("--kv-remote", dest="kv_remote", default=None,
+                   metavar="HOST:PORT",
+                   help="share prefix KV through a kv_pool server at "
+                        "HOST:PORT (LMCache lm:// parity; start one with "
+                        "python -m llm_in_practise_tpu.serve.kv_pool)")
     args = p.parse_args()
 
     tok = BPETokenizer.load(args.tokenizer_path)
@@ -75,11 +83,26 @@ def main():
         params = shard_fn(params)
         print(f"tensor parallel over {args.tp} devices")
 
+    kv_pool = None
+    if args.kv_offload or args.kv_remote:
+        from llm_in_practise_tpu.serve.kv_pool import (
+            HostKVPool, RemoteKVClient, TieredKV,
+        )
+
+        remote = None
+        if args.kv_remote:
+            rhost, rport = args.kv_remote.rsplit(":", 1)
+            remote = RemoteKVClient((rhost, int(rport)))
+        kv_pool = TieredKV(HostKVPool(), remote)
+        tiers = "HBM->host" + ("->remote" if remote else "")
+        print(f"tiered KV pool: {tiers}")
+
     engine_kw = dict(
         max_slots=args.max_slots, cache_len=args.cache_len,
         eos_id=tok.token_to_id(IM_END), cache_dtype=jnp.float32,
         prefix_cache=args.prefix_caching,
         chunked_prefill=args.chunked_prefill, mesh=mesh,
+        kv_pool=kv_pool,
     )
     engine = InferenceEngine(model, params, **engine_kw)
     adapters = {}

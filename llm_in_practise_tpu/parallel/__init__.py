@@ -1,9 +1,13 @@
-from llm_in_practise_tpu.parallel import pipeline, strategy
+from llm_in_practise_tpu.parallel import pipeline, pipeline_infer, strategy
 from llm_in_practise_tpu.parallel.pipeline import (
     make_pipeline_loss_fn,
     merge_gpt_params,
     pipeline_mesh,
     split_gpt_params,
+)
+from llm_in_practise_tpu.parallel.pipeline_infer import (
+    make_pipeline_forward,
+    pipeline_generate,
 )
 from llm_in_practise_tpu.parallel.strategy import (
     DEFAULT_RULES,
@@ -28,10 +32,13 @@ __all__ = [
     "expert_parallel",
     "fsdp",
     "fsdp_tp",
+    "make_pipeline_forward",
     "make_pipeline_loss_fn",
     "merge_gpt_params",
     "param_shardings",
     "pipeline",
+    "pipeline_generate",
+    "pipeline_infer",
     "pipeline_mesh",
     "shard_init",
     "split_gpt_params",

@@ -30,6 +30,16 @@ from llm_in_practise_tpu.serve.gateway import (  # noqa: F401
     Upstream,
 )
 from llm_in_practise_tpu.serve.prefix_cache import PrefixCache  # noqa: F401
+from llm_in_practise_tpu.serve.kv_pool import (  # noqa: F401
+    HostKVPool,
+    KVPoolServer,
+    RemoteKVClient,
+    TieredKV,
+)
+from llm_in_practise_tpu.serve.autoscale import (  # noqa: F401
+    AutoscaleConfig,
+    ReplicaAutoscaler,
+)
 from llm_in_practise_tpu.serve.moderation import (  # noqa: F401
     ModerationService,
     gateway_hook,

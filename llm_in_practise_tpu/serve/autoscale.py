@@ -1,0 +1,208 @@
+"""Replica autoscaling — the reference's Ray Serve / KEDA scaling story,
+in-process.
+
+The reference scales serving two ways:
+
+- Ray Serve app-level autoscaling (``Deployment/Ray/serve_deploy_examples/
+  qwen3_app_autoscaling.yaml:12-19``): ``min_replicas``/``max_replicas``,
+  ``target_ongoing_requests: 5``, ``upscale_delay_s``/``downscale_delay_s``,
+  ``max_ongoing_requests: 64`` per replica.
+- KEDA on Kubernetes (``LLM_on_Kubernetes/Inference_Platfrom/05-KEDA-AutoScale/
+  keda-scaledobject.yaml:37-55``): Prometheus triggers on queue depth / p99
+  TTFT, with HPA stabilization windows (the cluster-level analog lives in
+  ``deploy/k8s/03-autoscale/``).
+
+This module is the Ray-Serve-shaped half: a controller that watches
+ongoing requests across a :class:`~.gateway.Router` group and grows or
+shrinks the upstream set through user-supplied ``spawn``/``stop``
+callables (a thread-local engine replica, a subprocess, or a K8s scale
+call — the controller doesn't care). Decisions follow Ray's semantics:
+
+- desired = ceil(mean ongoing over ``look_back_period_s`` / target)
+- an upscale fires only after the need persists ``upscale_delay_s``;
+  a downscale only after ``downscale_delay_s`` (slow-down, fast-up)
+- always within [min_replicas, max_replicas]; downscale picks idle
+  replicas and **drains** them: a victim leaves the router (no new
+  picks) but is only stopped once its in-flight count reaches zero —
+  closing the race where a request selects an upstream in the instant
+  before teardown.
+
+``tick(now)`` is the whole control law — deterministic and clock-injected
+so tests drive it without sleeping; ``start()`` wraps it in a daemon
+thread for production use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import deque
+
+from llm_in_practise_tpu.serve.gateway import Router, Upstream
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Ray Serve ``autoscaling_config`` field-for-field (yaml:12-19)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 5.0
+    upscale_delay_s: float = 30.0
+    downscale_delay_s: float = 600.0
+    look_back_period_s: float = 30.0
+    metrics_interval_s: float = 10.0
+
+    def __post_init__(self):
+        if not (1 <= self.min_replicas <= self.max_replicas):
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be > 0")
+
+
+class ReplicaAutoscaler:
+    """Scale one router group's upstream set to its request load.
+
+    ``spawn() -> Upstream`` brings up a replica and returns its endpoint;
+    ``stop(upstream)`` tears one down. Both run on the controller thread
+    (or the caller of :meth:`tick`); the router sees membership changes
+    atomically under its list replacement.
+    """
+
+    def __init__(self, router: Router, group: str, *,
+                 spawn, stop, config: AutoscaleConfig | None = None,
+                 clock=time.time):
+        self.router = router
+        self.group = group
+        self.spawn = spawn
+        self.stop = stop
+        self.config = config or AutoscaleConfig()
+        self.clock = clock
+        # (ts, ongoing) samples inside the look-back window
+        self._samples: "deque[tuple[float, float]]" = deque()
+        self._want_up_since: float | None = None
+        self._want_down_since: float | None = None
+        self._draining: list[Upstream] = []
+        self._lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.upscales = 0
+        self.downscales = 0
+        self.errors = 0
+
+    # -- observability --------------------------------------------------------
+
+    def replicas(self) -> list[Upstream]:
+        return [u for u in self.router.upstreams if u.group == self.group]
+
+    def ongoing(self) -> int:
+        return sum(u.pending for u in self.replicas())
+
+    # -- the control law ------------------------------------------------------
+
+    def _mean_ongoing(self, now: float) -> float:
+        cfg = self.config
+        while self._samples and now - self._samples[0][0] > cfg.look_back_period_s:
+            self._samples.popleft()
+        if not self._samples:
+            return 0.0
+        return sum(v for _, v in self._samples) / len(self._samples)
+
+    def _reap_drained(self) -> int:
+        """Stop draining replicas whose last in-flight request finished."""
+        reaped = 0
+        for u in list(self._draining):
+            if u.pending == 0:
+                self._draining.remove(u)
+                self.stop(u)
+                reaped += 1
+        self.downscales += reaped
+        return reaped
+
+    def tick(self, now: float | None = None) -> int:
+        """One control step; returns the replica delta applied (+/-/0)."""
+        cfg = self.config
+        now = self.clock() if now is None else now
+        with self._lock:
+            reaped = self._reap_drained()
+            self._samples.append((now, float(self.ongoing())))
+            current = len(self.replicas())
+            desired = math.ceil(
+                self._mean_ongoing(now) / cfg.target_ongoing_requests)
+            desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+
+            if desired > current:
+                self._want_down_since = None
+                if self._want_up_since is None:
+                    self._want_up_since = now
+                if now - self._want_up_since < cfg.upscale_delay_s:
+                    return -reaped
+                self._want_up_since = None
+                fresh: list[Upstream] = []
+                try:
+                    for _ in range(desired - current):
+                        fresh.append(self.spawn())
+                finally:
+                    # register even a partial batch (a failed later spawn
+                    # must not leak the replicas already brought up);
+                    # atomic list swap: request threads iterate
+                    # router.upstreams without a lock — never mutate the
+                    # live list in place
+                    if fresh:
+                        self.router.upstreams = self.router.upstreams + fresh
+                        self.upscales += len(fresh)
+                return len(fresh) - reaped
+
+            if desired < current:
+                self._want_up_since = None
+                if self._want_down_since is None:
+                    self._want_down_since = now
+                if now - self._want_down_since < cfg.downscale_delay_s:
+                    return -reaped
+                self._want_down_since = None
+                # drain the idlest replicas: out of the router now (no new
+                # picks), stopped only once in-flight hits zero — a request
+                # that raced the selection finishes before teardown
+                victims = sorted(
+                    (u for u in self.replicas() if u.pending == 0),
+                    key=lambda u: u.served,
+                )[: current - desired]
+                if victims:
+                    gone = set(map(id, victims))
+                    # atomic list swap (see upscale)
+                    self.router.upstreams = [
+                        u for u in self.router.upstreams if id(u) not in gone]
+                    self._draining.extend(victims)
+                return -(reaped + self._reap_drained())
+
+            self._want_up_since = None
+            self._want_down_since = None
+            return -reaped
+
+    # -- background controller ------------------------------------------------
+
+    def start(self) -> "ReplicaAutoscaler":
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def run():
+            while not self._stop_event.wait(self.config.metrics_interval_s):
+                try:
+                    self.tick()
+                except Exception:  # a failed spawn must not kill the loop
+                    self.errors += 1
+                    log.exception("autoscaler tick failed for group %r "
+                                  "(failure #%d)", self.group, self.errors)
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -150,6 +150,7 @@ class InferenceEngine:
         prefix_cache: "PrefixCache | bool | None" = None,
         chunked_prefill: int | None = None,
         mesh=None,
+        kv_pool=None,
     ):
         self.model = model
         self.params = params
@@ -207,9 +208,23 @@ class InferenceEngine:
         # Prefix caching (vLLM APC parity): True -> default-sized cache.
         from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
 
-        if prefix_cache is True:
+        if prefix_cache is True or (not prefix_cache and kv_pool is not None):
             prefix_cache = PrefixCache()
         self.prefix_cache = prefix_cache or None
+        # Tiered offload (LMCache parity): L1 evictions flow into the
+        # host/remote pool instead of vanishing; lookups cascade back up.
+        self.kv_pool = kv_pool
+        if kv_pool is not None and self.prefix_cache is not None:
+            prior = self.prefix_cache.on_evict
+            def _evict(key, entry, _prior=prior):
+                if _prior is not None:
+                    _prior(key, entry)
+                # with write-through on, the entry already went down the
+                # tiers at prefill time — re-offloading on eviction would
+                # double every device_get + TCP put
+                if not kv_pool.offload_on_put:
+                    kv_pool.offload(list(key), entry)
+            self.prefix_cache.on_evict = _evict
 
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
         self._prefill = jax.jit(self._prefill_fn)
@@ -429,6 +444,11 @@ class InferenceEngine:
 
     def _lookup_prefix(self, req: Request, plen: int):
         def usable(entry) -> bool:
+            # rows from another engine (shared pool) may be padded to a
+            # bucket this engine's cache can't hold — the insert/suffix
+            # scatters would clamp and corrupt the slot
+            if entry.bucket > self.cache_len:
+                return False
             # every padded write the remaining prefill would do must land
             # inside cache_len, or the scatter clamps and corrupts the
             # prefix KV — either the one-shot bucket or the chunk span fits
@@ -440,7 +460,18 @@ class InferenceEngine:
 
         if self.prefix_cache is None:
             return None
-        return self.prefix_cache.lookup(req.prompt_ids, usable)
+        hit = self.prefix_cache.lookup(req.prompt_ids, usable)
+        if hit is not None or self.kv_pool is None:
+            return hit
+        # L1 miss: cascade into the host/remote pool; a hit is promoted
+        # back into L1 so the hot set migrates toward HBM. ``usable`` only
+        # reads entry.length, so it filters host entries before the
+        # device upload (and remote entries before promotion).
+        hit = self.kv_pool.lookup(req.prompt_ids, usable=usable)
+        if hit is None:
+            return None
+        self.prefix_cache.put(req.prompt_ids[: hit.length], hit)
+        return hit
 
     def _begin_prefill(self, req: Request, slot: int, plen: int) -> None:
         """Route one admitted request: full prefix hit → direct insert;
@@ -508,11 +539,16 @@ class InferenceEngine:
 
         if self.prefix_cache is not None:
             bucket = self._bucket_for(plen)
-            self.prefix_cache.put(req.prompt_ids, pc.PrefixEntry(
+            entry = pc.PrefixEntry(
                 length=plen, bucket=bucket,
                 rows=pc.slice_cache_rows(pre_cache, bucket),
                 last_logits=last_logits,
-            ))
+            )
+            self.prefix_cache.put(req.prompt_ids, entry)
+            if self.kv_pool is not None and self.kv_pool.offload_on_put:
+                # LMCache streaming write-through: the pool copy means a
+                # sibling / restarted engine starts with this prefix warm.
+                self.kv_pool.offload(req.prompt_ids[:plen], entry)
         self.cache = self._insert(
             self.cache, pre_cache, slot, jnp.asarray(plen, jnp.int32)
         )

@@ -1,0 +1,438 @@
+"""Tiered KV pool — the reference platform's LMCache stage, TPU-native.
+
+The reference extends vLLM's in-HBM prefix cache with LMCache
+(``LLM_on_Kubernetes/Inference_Platfrom/07-L1-Cache/LMCache/
+vllm-statefulset-lmcache.yaml:65-111``): KV blocks stream to CPU memory
+(``LMCACHE_LOCAL_CPU``) and to a remote ``lm://`` pool server
+(``lmcache-deployment.yaml``) so a prefix computed by one replica warms
+every other replica.
+
+Here the same three tiers fit the slot engine's prefix entries:
+
+- **L1** — :class:`~llm_in_practise_tpu.serve.prefix_cache.PrefixCache`:
+  device (HBM) KV rows, in-engine, budget is HBM.
+- **L2** — :class:`HostKVPool`: the same entries as host ``numpy`` arrays
+  (bfloat16 via ``ml_dtypes``), budget is host RAM — orders of magnitude
+  larger. Entries arrive by write-through on prefill and by eviction
+  from L1; a lookup re-uploads with ``jax.device_put`` and re-promotes
+  into L1.
+- **L3** — :class:`KVPoolServer` / :class:`RemoteKVClient`: a stdlib TCP
+  pool server (the ``lm://`` analog) holding the serialized entries, so
+  multiple engine replicas share one warm-prefix namespace. Transport is
+  a length-prefixed JSON header + raw array bytes — no pickle, no
+  third-party wire format.
+
+The engine talks to one :class:`TieredKV` facade; lookups cascade
+L1 → L2 → L3 and every hit is promoted upward, so the hot set migrates
+toward HBM exactly as in the reference's cache hierarchy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from llm_in_practise_tpu.serve.prefix_cache import PrefixLRU
+
+try:  # ml_dtypes ships with jax; it provides the numpy bfloat16 scalar type
+    import ml_dtypes
+
+    _NAMED_DTYPES = {
+        "bfloat16": np.dtype(ml_dtypes.bfloat16),
+        "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+        "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+    }
+except ImportError:  # pragma: no cover
+    _NAMED_DTYPES = {}
+
+
+def _dtype(name: str) -> np.dtype:
+    return _NAMED_DTYPES.get(name) or np.dtype(name)
+
+
+# --- host-side entry & (de)serialization -----------------------------------
+
+
+@dataclasses.dataclass
+class HostEntry:
+    """A prefix-cache entry with every buffer on host as numpy."""
+
+    length: int                 # true token count of the cached prefix
+    bucket: int                 # padded length of the stored rows
+    rows: list                  # per-layer {name: np.ndarray}
+    last_logits: np.ndarray     # (1, vocab) logits at the final position
+
+
+def entry_to_host(entry) -> HostEntry:
+    """Device ``PrefixEntry`` -> :class:`HostEntry` (one transfer per buffer)."""
+    import jax
+
+    rows = [{k: np.asarray(jax.device_get(v)) for k, v in layer.items()}
+            for layer in entry.rows]
+    return HostEntry(
+        length=entry.length,
+        bucket=entry.bucket,
+        rows=rows,
+        last_logits=np.asarray(jax.device_get(entry.last_logits)),
+    )
+
+
+def entry_to_device(host: HostEntry):
+    """:class:`HostEntry` -> device ``PrefixEntry`` (replicated placement;
+    a TP engine's jitted programs reshard on first use)."""
+    import jax
+
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixEntry
+
+    rows = [{k: jax.device_put(v) for k, v in layer.items()}
+            for layer in host.rows]
+    return PrefixEntry(
+        length=host.length,
+        bucket=host.bucket,
+        rows=rows,
+        last_logits=jax.device_put(host.last_logits),
+    )
+
+
+def encode_entry(host: HostEntry) -> bytes:
+    """Self-describing binary blob: JSON manifest + concatenated raw bytes."""
+    arrays: list[np.ndarray] = []
+    manifest_rows = []
+    for layer in host.rows:
+        layer_meta = {}
+        for name in sorted(layer):
+            arr = np.ascontiguousarray(layer[name])
+            layer_meta[name] = {"shape": list(arr.shape),
+                                "dtype": arr.dtype.name}
+            arrays.append(arr)
+        manifest_rows.append(layer_meta)
+    logits = np.ascontiguousarray(host.last_logits)
+    manifest = {
+        "length": host.length,
+        "bucket": host.bucket,
+        "rows": manifest_rows,
+        "last_logits": {"shape": list(logits.shape),
+                        "dtype": logits.dtype.name},
+    }
+    arrays.append(logits)
+    head = json.dumps(manifest).encode()
+    return b"".join([struct.pack("<I", len(head)), head,
+                     *(a.tobytes() for a in arrays)])
+
+
+def decode_entry(blob: bytes) -> HostEntry:
+    (hlen,) = struct.unpack_from("<I", blob, 0)
+    manifest = json.loads(blob[4: 4 + hlen].decode())
+    off = 4 + hlen
+
+    def take(meta) -> np.ndarray:
+        nonlocal off
+        dt = _dtype(meta["dtype"])
+        n = int(np.prod(meta["shape"])) * dt.itemsize
+        arr = np.frombuffer(blob, dtype=dt, count=int(np.prod(meta["shape"])),
+                            offset=off).reshape(meta["shape"])
+        off += n
+        return arr
+
+    rows = [{name: take(meta) for name, meta in sorted(layer.items())}
+            for layer in manifest["rows"]]
+    return HostEntry(length=manifest["length"], bucket=manifest["bucket"],
+                     rows=rows, last_logits=take(manifest["last_logits"]))
+
+
+# --- L2: host-RAM pool ------------------------------------------------------
+
+
+class HostKVPool(PrefixLRU):
+    """LRU of host-resident prefix entries — the shared
+    :class:`~.prefix_cache.PrefixLRU` store with :class:`HostEntry`
+    values. The budget is host RAM, counted in cached tokens (LMCache's
+    ``LMCACHE_MAX_LOCAL_CPU_SIZE`` knob)."""
+
+    def __init__(self, *, max_tokens: int = 1 << 20, min_prefix: int = 16):
+        super().__init__(max_tokens=max_tokens, min_prefix=min_prefix)
+
+
+# --- L3: remote pool server (the ``lm://`` analog) --------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("kv pool peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    head = json.dumps(header).encode()
+    sock.sendall(struct.pack("<II", len(head), len(payload)) + head + payload)
+
+
+def _recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
+    hlen, plen = struct.unpack("<II", _recv_exact(sock, 8))
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+class KVPoolServer:
+    """Shared prefix-KV pool over TCP (reference: the LMCache server the
+    statefulset points ``LMCACHE_REMOTE_URL: lm://...`` at).
+
+    Keys are token tuples; ``get`` performs the longest-strict-prefix
+    match server-side so clients need one round-trip. LRU by tokens."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 max_tokens: int = 1 << 22, min_prefix: int = 16):
+        self.min_prefix = min_prefix
+        self.max_tokens = max_tokens
+        # values are (length, bucket, blob) tuples in the shared store
+        self._store = PrefixLRU(max_tokens=max_tokens, min_prefix=min_prefix,
+                                length_of=lambda v: v[0])
+        pool = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        header, payload = _recv_msg(self.request)
+                        pool._dispatch(self.request, header, payload)
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "KVPoolServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- ops ----------------------------------------------------------------
+
+    def _dispatch(self, sock, header: dict, payload: bytes) -> None:
+        op = header.get("op")
+        if op == "put":
+            self._put(tuple(header["key"]), int(header["length"]),
+                      int(header["bucket"]), payload)
+            _send_msg(sock, {"ok": True})
+        elif op == "get":
+            found = self._get(tuple(header["prompt"]))
+            if found is None:
+                _send_msg(sock, {"found": False})
+            else:
+                length, bucket, blob = found
+                _send_msg(sock, {"found": True, "length": length,
+                                 "bucket": bucket}, blob)
+        elif op == "stats":
+            _send_msg(sock, {
+                "entries": self._store.n_entries,
+                "cached_tokens": self._store.cached_tokens,
+                "hits": self.hits, "misses": self.misses,
+            })
+        else:
+            _send_msg(sock, {"ok": False, "error": f"unknown op {op!r}"})
+
+    @property
+    def hits(self) -> int:
+        return self._store.hits
+
+    @property
+    def misses(self) -> int:
+        return self._store.misses
+
+    @property
+    def _entries(self):
+        return self._store._entries
+
+    def _put(self, key: tuple, length: int, bucket: int, blob: bytes) -> None:
+        self._store.put(list(key), (length, bucket, blob))
+
+    def _get(self, prompt: tuple):
+        return self._store.lookup(prompt)
+
+
+class RemoteKVClient:
+    """One engine's handle on a :class:`KVPoolServer` (connection per call —
+    the pool is hit only on L1+L2 misses and on offload)."""
+
+    def __init__(self, address: tuple[str, int], *, timeout: float = 5.0):
+        self.address = tuple(address)
+        self.timeout = timeout
+
+    def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+        with socket.create_connection(self.address, timeout=self.timeout) as s:
+            _send_msg(s, header, payload)
+            return _recv_msg(s)
+
+    def put(self, prompt_ids, host: HostEntry) -> None:
+        key = list(prompt_ids[: host.length])
+        self._call({"op": "put", "key": key, "length": host.length,
+                    "bucket": host.bucket}, encode_entry(host))
+
+    def get(self, prompt_ids) -> HostEntry | None:
+        header, payload = self._call({"op": "get", "prompt": list(prompt_ids)})
+        if not header.get("found"):
+            return None
+        return decode_entry(payload)
+
+    def stats(self) -> dict:
+        header, _ = self._call({"op": "stats"})
+        return header
+
+
+# --- the facade the engine holds -------------------------------------------
+
+
+class TieredKV:
+    """L2 (+optional L3) behind one lookup/offload surface.
+
+    ``offload_on_put=True`` (LMCache's streaming write-through) copies
+    every finished prefill's entry down the tiers, so a restarting or
+    sibling engine starts warm; ``False`` offloads only on L1 eviction.
+
+    Offloads run on a background worker by default (``async_offload``):
+    the device→host transfer and the remote TCP put must not stall the
+    engine's decode loop — a dead pool server would otherwise freeze
+    every running stream for the connect timeout. The queue is bounded;
+    overflow drops the offload (counted in ``dropped``) rather than
+    applying backpressure to serving. ``flush()`` drains the queue —
+    tests and orderly shutdown use it."""
+
+    def __init__(self, host_pool: HostKVPool | None = None,
+                 remote: RemoteKVClient | None = None, *,
+                 offload_on_put: bool = True, async_offload: bool = True,
+                 queue_size: int = 64, remote_cooldown_s: float = 30.0,
+                 clock=None):
+        self.host_pool = host_pool if host_pool is not None else HostKVPool()
+        self.remote = remote
+        self.offload_on_put = offload_on_put
+        self.remote_errors = 0
+        self.dropped = 0
+        # circuit breaker: lookups run on the engine loop thread, so a
+        # dead pool server must not cost a connect timeout per admission —
+        # after one failure the remote sits out remote_cooldown_s
+        self.remote_cooldown_s = remote_cooldown_s
+        self._remote_down_until = 0.0
+        self._clock = clock or __import__("time").monotonic
+        self._queue: "queue.Queue | None" = (
+            queue.Queue(maxsize=queue_size) if async_offload else None)
+        self._worker: threading.Thread | None = None
+
+    def _remote_ok(self) -> bool:
+        return (self.remote is not None
+                and self._clock() >= self._remote_down_until)
+
+    def _remote_failed(self) -> None:
+        self.remote_errors += 1
+        self._remote_down_until = self._clock() + self.remote_cooldown_s
+
+    # -- offload path ---------------------------------------------------------
+
+    def _offload_now(self, prompt_ids, entry) -> None:
+        host = entry_to_host(entry)
+        self.host_pool.put(prompt_ids, host)
+        if self._remote_ok():
+            try:
+                self.remote.put(prompt_ids, host)
+            except OSError:
+                self._remote_failed()
+
+    def _run_worker(self) -> None:
+        while True:
+            prompt_ids, entry = self._queue.get()
+            try:
+                self._offload_now(prompt_ids, entry)
+            except Exception:
+                self.remote_errors += 1
+            finally:
+                self._queue.task_done()
+
+    def offload(self, prompt_ids, entry) -> None:
+        """Device ``PrefixEntry`` -> host pool (+ remote, best-effort)."""
+        if self._queue is None:
+            self._offload_now(prompt_ids, entry)
+            return
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._run_worker,
+                                            daemon=True)
+            self._worker.start()
+        try:
+            # entry.rows are immutable device arrays (sliced copies, never
+            # donated), so deferring the device_get is safe
+            self._queue.put_nowait((list(prompt_ids), entry))
+        except queue.Full:
+            self.dropped += 1
+
+    def flush(self) -> None:
+        """Block until every queued offload has landed in the tiers."""
+        if self._queue is not None and self._worker is not None:
+            self._queue.join()
+
+    # -- lookup path ----------------------------------------------------------
+
+    def lookup(self, prompt_ids, usable=None):
+        """Longest host/remote prefix as a device ``PrefixEntry`` (or None).
+
+        ``usable(entry)`` may read ``entry.length``/``entry.bucket`` only
+        (it sees :class:`HostEntry` here, device entries at L1) — applied
+        *before* the device upload, and before promoting a remote hit
+        into the host pool, so unusable prefixes cost no transfers."""
+        host = self.host_pool.lookup(prompt_ids, usable=usable)
+        if host is None and self._remote_ok():
+            try:
+                host = self.remote.get(prompt_ids)
+            except OSError:
+                self._remote_failed()
+                host = None
+            if host is not None and usable is not None and not usable(host):
+                host = None
+            if host is not None:
+                self.host_pool.put(prompt_ids, host)
+        if host is None:
+            return None
+        return entry_to_device(host)
+
+
+def main() -> None:
+    """Run a standalone pool server — the reference's LMCache server
+    Deployment (``07-L1-Cache/LMCache/lmcache-deployment.yaml``)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--max-tokens", type=int, default=1 << 22,
+                   help="pool budget in cached prefix tokens")
+    args = p.parse_args()
+    server = KVPoolServer(args.host, args.port, max_tokens=args.max_tokens)
+    server.start()
+    print(f"kv pool server on {server.address[0]}:{server.address[1]} "
+          f"(budget {args.max_tokens} tokens)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

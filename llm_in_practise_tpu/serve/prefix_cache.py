@@ -16,7 +16,12 @@ prefill). A full-prompt hit skips prefill entirely (the stored
 last-position logits seed the first sampled token).
 
 Eviction: LRU by total cached tokens. Entries are device arrays — the
-budget is HBM, so default caps are modest.
+budget is HBM, so default caps are modest; evictions flow into the
+:mod:`.kv_pool` tiers when one is attached (the LMCache handoff).
+
+:class:`PrefixLRU` is the shared store — the host pool and the remote
+pool server in :mod:`.kv_pool` reuse the same budget/eviction/matching
+logic with different value types.
 """
 
 from __future__ import annotations
@@ -36,73 +41,111 @@ class PrefixEntry:
     last_logits: object   # (1, vocab) logits at the final prefix position
 
 
-class PrefixCache:
-    """LRU of prompt-prefix KV rows, keyed by exact token tuples."""
+class PrefixLRU:
+    """Token-budget LRU keyed by exact token tuples, with
+    longest-strict-prefix lookup.
 
-    def __init__(self, *, max_tokens: int = 32768, min_prefix: int = 16):
+    Generic over the value type: ``length_of(value)`` must return the
+    value's true token count. ``on_evict(key, value)`` fires (outside the
+    lock) for every budget eviction — tier handoff hooks attach here.
+    """
+
+    def __init__(self, *, max_tokens: int, min_prefix: int,
+                 length_of=None, on_evict=None):
         self.max_tokens = max_tokens
         self.min_prefix = min_prefix
-        self._entries: "OrderedDict[tuple, PrefixEntry]" = OrderedDict()
-        # internal lock: the engine thread mutates while /metrics reads
+        self.on_evict = on_evict
+        self._length_of = length_of or (lambda v: v.length)
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+        # internal lock: the owner's worker thread mutates while /metrics
+        # (or another engine thread) reads
         self._lock = threading.Lock()
         self._total_tokens = 0
         self.hits = 0
-        self.full_hits = 0
         self.misses = 0
-        self.tokens_saved = 0
 
     @property
     def cached_tokens(self) -> int:
         with self._lock:
             return self._total_tokens
 
-    def lookup(self, prompt_ids: list[int], usable=None) -> PrefixEntry | None:
-        """Longest cached entry that is a prefix of ``prompt_ids``.
+    @property
+    def n_entries(self) -> int:
+        # deliberately not __len__: an empty cache must stay truthy
+        # (callers write ``prefix_cache or None`` to normalize False)
+        with self._lock:
+            return len(self._entries)
 
-        ``usable(entry)`` (optional) filters candidates — the engine uses it
-        to reject prefixes whose suffix prefill wouldn't fit the cache.
+    def lookup(self, prompt_ids, usable=None):
+        """Longest cached value that is a prefix of ``prompt_ids``.
+
+        ``usable(value)`` (optional) filters candidates — the engine uses
+        it to reject prefixes whose suffix prefill wouldn't fit the cache.
         """
         prompt = tuple(prompt_ids)
         with self._lock:
             best_key, best = None, None
-            for key, entry in self._entries.items():
-                if entry.length < self.min_prefix or entry.length > len(prompt):
+            for key, value in self._entries.items():
+                length = self._length_of(value)
+                if length < self.min_prefix or length > len(prompt):
                     continue
-                if best is not None and entry.length <= best.length:
+                if best is not None and length <= self._length_of(best):
                     continue
-                if prompt[: entry.length] != key:
+                if prompt[:length] != key:
                     continue
-                if usable is not None and not usable(entry):
+                if usable is not None and not usable(value):
                     continue
-                best_key, best = key, entry
+                best_key, best = key, value
             if best is None:
                 self.misses += 1
                 return None
             self._entries.move_to_end(best_key)
             self.hits += 1
-            if best.length == len(prompt):
-                self.full_hits += 1
-            self.tokens_saved += best.length
             return best
 
-    def put(self, prompt_ids: list[int], entry: PrefixEntry) -> None:
-        if entry.length < self.min_prefix:
+    def put(self, prompt_ids, value) -> None:
+        length = self._length_of(value)
+        if length < self.min_prefix:
             return
-        key = tuple(prompt_ids[: entry.length])
+        key = tuple(prompt_ids[:length])
+        evicted: list[tuple[tuple, object]] = []
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
-                self._total_tokens -= old.length
-            self._entries[key] = entry
-            self._total_tokens += entry.length
+                self._total_tokens -= self._length_of(old)
+            self._entries[key] = value
+            self._total_tokens += length
             while self._total_tokens > self.max_tokens and len(self._entries) > 1:
-                _, evicted = self._entries.popitem(last=False)
-                self._total_tokens -= evicted.length
+                ekey, evalue = self._entries.popitem(last=False)
+                self._total_tokens -= self._length_of(evalue)
+                evicted.append((ekey, evalue))
+        if self.on_evict is not None:
+            for ekey, evalue in evicted:
+                self.on_evict(ekey, evalue)
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self._total_tokens = 0
+
+
+class PrefixCache(PrefixLRU):
+    """The engine's L1: device-array prefix entries + reuse accounting."""
+
+    def __init__(self, *, max_tokens: int = 32768, min_prefix: int = 16,
+                 on_evict=None):
+        super().__init__(max_tokens=max_tokens, min_prefix=min_prefix,
+                         on_evict=on_evict)
+        self.full_hits = 0
+        self.tokens_saved = 0
+
+    def lookup(self, prompt_ids, usable=None) -> PrefixEntry | None:
+        entry = super().lookup(prompt_ids, usable)
+        if entry is not None:
+            self.tokens_saved += entry.length
+            if entry.length == len(prompt_ids):
+                self.full_hits += 1
+        return entry
 
 
 def slice_cache_rows(prefill_cache, bucket: int) -> list:

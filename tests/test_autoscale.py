@@ -1,0 +1,134 @@
+"""Replica autoscaler control law (Ray Serve autoscaling_config parity):
+delayed upscale, slow downscale, clamping, idle-only victim selection —
+all driven through a fake clock, no threads, no engines."""
+
+import pytest
+
+from llm_in_practise_tpu.serve.autoscale import AutoscaleConfig, ReplicaAutoscaler
+from llm_in_practise_tpu.serve.gateway import Router, Upstream
+
+
+def _make(n_start=1, **cfg_kw):
+    counter = {"n": 0}
+
+    def spawn():
+        counter["n"] += 1
+        return Upstream(base_url=f"http://r{counter['n']}", model="m",
+                        group="g")
+
+    stopped = []
+    router = Router([spawn() for _ in range(n_start)])
+    cfg = AutoscaleConfig(**cfg_kw)
+    scaler = ReplicaAutoscaler(router, "g", spawn=spawn, stop=stopped.append,
+                               config=cfg, clock=lambda: 0.0)
+    return router, scaler, stopped
+
+
+def _load(router, pending):
+    for u, p in zip(router.upstreams, pending):
+        u.pending = p
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(target_ongoing_requests=0)
+
+
+def test_upscale_waits_for_delay_then_fires():
+    router, scaler, _ = _make(
+        n_start=1, target_ongoing_requests=5, upscale_delay_s=30,
+        look_back_period_s=30, max_replicas=4)
+    _load(router, [12])                      # 12 ongoing / target 5 → want 3
+    assert scaler.tick(now=0.0) == 0         # need observed, delay starts
+    assert scaler.tick(now=10.0) == 0        # still inside upscale_delay
+    assert scaler.tick(now=31.0) == 2        # delay elapsed → +2 replicas
+    assert len(router.upstreams) == 3
+    assert scaler.upscales == 2
+
+
+def test_upscale_need_must_persist():
+    router, scaler, _ = _make(
+        n_start=1, target_ongoing_requests=5, upscale_delay_s=30,
+        look_back_period_s=10)
+    _load(router, [12])
+    scaler.tick(now=0.0)
+    _load(router, [0])                       # load vanished
+    for t in (15.0, 25.0, 40.0):             # old samples age out of window
+        assert scaler.tick(now=t) == 0
+    assert len(router.upstreams) == 1        # no flappy upscale
+
+
+def test_downscale_is_slow_and_prefers_idle():
+    router, scaler, stopped = _make(
+        n_start=3, target_ongoing_requests=5, downscale_delay_s=600,
+        look_back_period_s=10, min_replicas=1)
+    busy = router.upstreams[0]
+    _load(router, [3, 0, 0])                 # mean 3 → desired 1
+    assert scaler.tick(now=0.0) == 0
+    assert scaler.tick(now=300.0) == 0       # inside downscale_delay
+    assert scaler.tick(now=601.0) == -2
+    assert router.upstreams == [busy]        # busy replica survived
+    assert len(stopped) == 2
+    assert scaler.downscales == 2
+
+
+def test_never_stops_replica_with_inflight_requests():
+    router, scaler, stopped = _make(
+        n_start=3, target_ongoing_requests=100, downscale_delay_s=0,
+        look_back_period_s=1, min_replicas=1)
+    _load(router, [1, 1, 1])                 # all busy; desired=1
+    scaler.tick(now=0.0)
+    delta = scaler.tick(now=5.0)
+    assert delta == 0 and not stopped        # nothing idle → nothing stopped
+
+
+def test_clamped_to_max_and_min():
+    router, scaler, _ = _make(
+        n_start=1, target_ongoing_requests=1, upscale_delay_s=0,
+        look_back_period_s=1, max_replicas=3)
+    _load(router, [50])
+    scaler.tick(now=0.0)
+    scaler.tick(now=1.0)
+    assert len(router.upstreams) == 3        # capped at max_replicas
+    # load goes to zero → desired clamps at min_replicas (1), not 0
+    _load(router, [0, 0, 0])
+    router2, scaler2, _ = _make(
+        n_start=2, target_ongoing_requests=5, downscale_delay_s=0,
+        look_back_period_s=1, min_replicas=1)
+    _load(router2, [0, 0])
+    scaler2.tick(now=100.0)
+    scaler2.tick(now=102.0)
+    assert len(router2.upstreams) == 1
+
+
+def test_draining_replica_stops_only_after_inflight_finishes():
+    """A victim that a request raced onto is drained, not killed: out of
+    the router immediately, stopped only when pending returns to zero."""
+    router, scaler, stopped = _make(
+        n_start=2, target_ongoing_requests=100, downscale_delay_s=0,
+        look_back_period_s=1, min_replicas=1)
+    victim = router.upstreams[1]
+    victim.pending = 1                       # racing request in flight
+    router.upstreams.remove(victim)
+    scaler._draining.append(victim)          # state after victim selection
+    assert scaler.tick(now=0.0) == 0 and not stopped
+    victim.pending = 0                       # request completed
+    assert scaler.tick(now=1.0) == -1
+    assert stopped == [victim]
+    assert scaler.downscales == 1
+
+
+def test_steady_state_resets_pending_decisions():
+    router, scaler, _ = _make(
+        n_start=2, target_ongoing_requests=5, upscale_delay_s=30,
+        look_back_period_s=5)
+    _load(router, [20, 20])                  # want 8 → capped 4: upscale arm
+    scaler.tick(now=0.0)
+    _load(router, [5, 5])                    # back at target → disarm
+    scaler.tick(now=10.0)
+    _load(router, [20, 20])
+    assert scaler.tick(now=35.0) == 0        # delay restarted at re-arm

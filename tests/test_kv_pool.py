@@ -1,0 +1,286 @@
+"""Tiered KV pool (LMCache parity): blob roundtrip, host-pool LRU +
+prefix matching, the TCP pool server, and engine-level tier cascades —
+eviction offload, pool re-hit, and cross-engine prefix sharing."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
+from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+from llm_in_practise_tpu.serve.kv_pool import (
+    HostEntry,
+    HostKVPool,
+    KVPoolServer,
+    RemoteKVClient,
+    TieredKV,
+    decode_entry,
+    encode_entry,
+)
+
+
+def _host_entry(length=16, bucket=16, layers=2, dtype=np.float32):
+    rng = np.random.default_rng(length)
+    rows = [
+        {
+            "k": rng.standard_normal((1, bucket, 2, 4)).astype(dtype),
+            "v": rng.standard_normal((1, bucket, 2, 4)).astype(dtype),
+        }
+        for _ in range(layers)
+    ]
+    logits = rng.standard_normal((1, 64)).astype(np.float32)
+    return HostEntry(length=length, bucket=bucket, rows=rows,
+                     last_logits=logits)
+
+
+def test_blob_roundtrip_fp32_and_bf16():
+    for dtype in (np.float32, jnp.bfloat16):
+        entry = _host_entry(dtype=np.dtype(dtype))
+        out = decode_entry(encode_entry(entry))
+        assert out.length == entry.length and out.bucket == entry.bucket
+        assert len(out.rows) == len(entry.rows)
+        for got, want in zip(out.rows, entry.rows):
+            for key in want:
+                assert got[key].dtype == want[key].dtype
+                np.testing.assert_array_equal(got[key], want[key])
+        np.testing.assert_array_equal(out.last_logits, entry.last_logits)
+
+
+def test_host_pool_longest_prefix_and_lru():
+    pool = HostKVPool(max_tokens=64, min_prefix=4)
+    short = list(range(8))
+    long = list(range(16))
+    pool.put(short, _host_entry(length=8, bucket=8))
+    pool.put(long, _host_entry(length=16, bucket=16))
+    # longest strict prefix wins
+    hit = pool.lookup(list(range(20)))
+    assert hit is not None and hit.length == 16
+    # miss: diverging tokens
+    assert pool.lookup([99, 98, 97, 96, 95]) is None
+    # LRU eviction: inserting 48 tokens on a 64 budget with 24 already
+    # present (short=8 was just touched via the length-16 lookup? no —
+    # lookup touched the 16-entry) evicts the least-recently-used
+    pool.put(list(range(100, 148)), _host_entry(length=48, bucket=48))
+    assert pool.cached_tokens <= 64
+
+
+def test_pool_server_roundtrip_and_prefix_match():
+    server = KVPoolServer(min_prefix=4).start()
+    try:
+        client = RemoteKVClient(server.address)
+        prompt = list(range(32))
+        client.put(prompt, _host_entry(length=32, bucket=32))
+        # full + extension both resolve to the stored 32-token prefix
+        for query in (prompt, prompt + [7, 7, 7]):
+            got = client.get(query)
+            assert got is not None and got.length == 32
+        assert client.get([5, 4, 3, 2, 1]) is None
+        stats = client.stats()
+        assert stats["entries"] == 1 and stats["hits"] == 2
+    finally:
+        server.stop()
+
+
+def test_pool_server_concurrent_clients():
+    server = KVPoolServer(min_prefix=4).start()
+    try:
+        errors = []
+
+        def worker(base):
+            try:
+                client = RemoteKVClient(server.address)
+                prompt = list(range(base, base + 16))
+                client.put(prompt, _host_entry(length=16, bucket=16))
+                got = client.get(prompt)
+                assert got is not None and got.length == 16
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i * 100,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+    finally:
+        server.stop()
+
+
+# --- engine-level tier behavior ---------------------------------------------
+
+
+def _tiny_model(rng):
+    cfg = GPTConfig(
+        vocab_size=64, seq_len=128, n_layer=2, n_head=2, embed_dim=32,
+        dropout=0.0, pos_embedding="rope",
+    )
+    model = GPT(cfg)
+    params = model.init(rng, jnp.ones((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+PROMPT_A = list(range(1, 33))          # 32 tokens — cacheable prefix
+PROMPT_B = list(range(40, 60))         # different prefix, forces eviction
+
+
+def test_engine_offloads_on_eviction_and_rehits_from_host_pool(rng):
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+    model, params = _tiny_model(rng)
+    pool = TieredKV(HostKVPool(min_prefix=8), offload_on_put=False)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        prefix_cache=PrefixCache(max_tokens=40, min_prefix=8),  # tiny L1
+        kv_pool=pool,
+    )
+    sp = SamplingParams(greedy=True, max_tokens=6)
+    cold = engine.generate(PROMPT_A, sp)
+    # B's 20-token entry pushes A (32 tokens) over the 40-token L1 budget
+    engine.generate(PROMPT_B, sp)
+    pool.flush()                               # drain the async offload
+    assert pool.host_pool.cached_tokens >= 32  # A was offloaded, not dropped
+    warm = engine.generate(PROMPT_A, sp)
+    assert warm == cold
+    assert pool.host_pool.hits >= 1
+
+
+def test_engine_writethrough_shares_prefix_across_engines(rng):
+    """Engine 1 prefills; engine 2 (same weights, cold caches) must hit the
+    shared remote pool — the LMCache cross-replica warm-up story."""
+    model, params = _tiny_model(rng)
+    server = KVPoolServer(min_prefix=8).start()
+    try:
+        sp = SamplingParams(greedy=True, max_tokens=6)
+
+        pool1 = TieredKV(HostKVPool(min_prefix=8),
+                         RemoteKVClient(server.address))
+        eng1 = InferenceEngine(model, params, max_slots=2, cache_len=128,
+                               cache_dtype=jnp.float32, kv_pool=pool1)
+        out1 = eng1.generate(PROMPT_A, sp)
+        pool1.flush()
+        assert server._entries, "write-through should populate the server"
+
+        pool2 = TieredKV(HostKVPool(min_prefix=8),
+                         RemoteKVClient(server.address))
+        eng2 = InferenceEngine(model, params, max_slots=2, cache_len=128,
+                               cache_dtype=jnp.float32, kv_pool=pool2)
+        out2 = eng2.generate(PROMPT_A, sp)
+        assert out2 == out1
+        assert pool2.host_pool.misses >= 1      # L2 missed...
+        assert server.hits >= 1                 # ...remote served it
+        assert eng2.prefix_cache.cached_tokens >= len(PROMPT_A)  # promoted
+        # the promoted entry now serves repeats straight from L1
+        assert eng2.generate(PROMPT_A, sp) == out1
+        assert eng2.prefix_cache.full_hits >= 1
+    finally:
+        server.stop()
+
+
+def test_pool_entry_respects_usable_filter(rng):
+    """A pool hit whose suffix prefill can't fit the cache must be ignored
+    (same guard as L1 — otherwise the scatter would corrupt slot KV)."""
+    model, params = _tiny_model(rng)
+    pool = TieredKV(HostKVPool(min_prefix=8), offload_on_put=True)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        kv_pool=pool,
+    )
+    sp = SamplingParams(greedy=True, max_tokens=4)
+    engine.generate(PROMPT_A, sp)
+    pool.flush()
+    # a 120-token prompt sharing A's prefix: 32 done + 128-bucket suffix
+    # exceeds cache_len → the hit must be filtered, not used
+    long_prompt = PROMPT_A + list(range(200, 288))
+    out = engine.generate(long_prompt, SamplingParams(greedy=True,
+                                                      max_tokens=2))
+    assert len(out) == 2
+
+
+def test_oversized_pool_entry_is_filtered_before_upload(rng):
+    """A shared-pool entry padded beyond this engine's cache_len must be
+    rejected by usable() before any device upload — the rows here have
+    bogus shapes, so touching them would fail loudly."""
+    model, params = _tiny_model(rng)
+    pool = TieredKV(HostKVPool(min_prefix=8), offload_on_put=False)
+    big = _host_entry(length=32, bucket=256)   # bucket > cache_len=128
+    pool.host_pool.put(PROMPT_A, big)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        kv_pool=pool,
+    )
+    out = engine.generate(PROMPT_A, SamplingParams(greedy=True, max_tokens=4))
+    assert len(out) == 4                       # cold prefill, no crash
+
+
+def test_remote_circuit_breaker_after_failure():
+    clock = {"t": 0.0}
+    pool = TieredKV(
+        HostKVPool(min_prefix=4),
+        RemoteKVClient(("127.0.0.1", 1), timeout=0.2),  # nothing listens
+        remote_cooldown_s=30.0, clock=lambda: clock["t"],
+    )
+    assert pool.lookup(list(range(16))) is None
+    assert pool.remote_errors == 1
+    # inside the cooldown the dead remote is skipped entirely
+    assert pool.lookup(list(range(16))) is None
+    assert pool.remote_errors == 1
+    clock["t"] = 31.0                          # cooldown over → retried
+    assert pool.lookup(list(range(16))) is None
+    assert pool.remote_errors == 2
+
+
+def test_writethrough_entry_not_reoffloaded_on_eviction(rng):
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+    model, params = _tiny_model(rng)
+    pool = TieredKV(HostKVPool(min_prefix=8), async_offload=False)
+    calls = []
+    orig = pool.offload
+    pool.offload = lambda ids, e: (calls.append(tuple(ids)), orig(ids, e))
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        prefix_cache=PrefixCache(max_tokens=40, min_prefix=8),
+        kv_pool=pool,
+    )
+    sp = SamplingParams(greedy=True, max_tokens=4)
+    engine.generate(PROMPT_A, sp)              # write-through offload #1
+    engine.generate(PROMPT_B, sp)              # evicts A; must NOT re-offload
+    a_offloads = [c for c in calls if c[: len(PROMPT_A)] == tuple(PROMPT_A)]
+    assert len(a_offloads) == 1
+
+
+def test_kv_pool_auto_enables_prefix_cache(rng):
+    """``--kv-offload`` without ``--enable-prefix-caching`` must still tier
+    (the engine auto-creates the L1 the pool feeds from), even when the
+    caller passes prefix_cache=False explicitly."""
+    model, params = _tiny_model(rng)
+    pool = TieredKV(HostKVPool(min_prefix=8), async_offload=False)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        prefix_cache=False, kv_pool=pool,
+    )
+    assert engine.prefix_cache is not None
+    engine.generate(PROMPT_A, SamplingParams(greedy=True, max_tokens=4))
+    assert pool.host_pool.cached_tokens >= len(PROMPT_A)  # write-through ran
+
+
+def test_caller_on_evict_hook_is_chained(rng):
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+    model, params = _tiny_model(rng)
+    seen = []
+    pool = TieredKV(HostKVPool(min_prefix=8), offload_on_put=False,
+                    async_offload=False)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        prefix_cache=PrefixCache(max_tokens=40, min_prefix=8,
+                                 on_evict=lambda k, e: seen.append(k)),
+        kv_pool=pool,
+    )
+    sp = SamplingParams(greedy=True, max_tokens=4)
+    engine.generate(PROMPT_A, sp)
+    engine.generate(PROMPT_B, sp)            # evicts A from the tiny L1
+    assert seen and seen[0][: len(PROMPT_A)] == tuple(PROMPT_A)
+    assert pool.host_pool.cached_tokens >= 32  # offload also ran
