@@ -1,0 +1,190 @@
+"""RAG chat over a local knowledge base — the AnythingLLM analog.
+
+The reference deploys AnythingLLM next to Ollama/Open-WebUI as its RAG
+story (``Deployment/AnythingLLM/docker-compose.yml``): documents are
+chunked, embedded, retrieved by cosine similarity, and stuffed into the
+chat prompt. Same pipeline here, dependency-free and against this
+framework's models:
+
+- **chunk**: sliding window over words with overlap;
+- **embed**: either the hashed bag-of-tokens embedding the gateway's
+  semantic cache uses (no model, instant) or mean-pooled hidden states
+  from an in-tree checkpoint (``--embedder model``);
+- **retrieve**: cosine top-k over the chunk matrix (one matmul);
+- **generate**: ChatML prompt with the retrieved context, decoded with
+  the same generate loop every other example uses.
+
+Run retrieval-only against the in-repo docs (hermetic, no checkpoint):
+
+    python examples/rag_chat.py --ask "how does ring attention work?"
+
+or with a fine-tuned checkpoint for grounded answers:
+
+    python examples/rag_chat.py --model_path /tmp/qwen3_merged/model.msgpack \\
+        --tokenizer_path /tmp/qwen3_sft_bpe.json --ask "..."
+"""
+
+import argparse
+import hashlib
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# --- knowledge base ----------------------------------------------------------
+
+
+def chunk_text(text: str, *, size: int = 160, overlap: int = 40):
+    """Sliding word-window chunks (the AnythingLLM chunker's shape)."""
+    words = text.split()
+    step = max(size - overlap, 1)
+    out = []
+    for start in range(0, max(len(words) - overlap, 1), step):
+        piece = " ".join(words[start: start + size])
+        if piece:
+            out.append(piece)
+    return out
+
+
+def hash_embed(text: str, dim: int = 256):
+    """Hashed bag-of-tokens embedding (the gateway semantic cache's
+    embedder) — no model, deterministic, good enough to rank chunks."""
+    vec = [0.0] * dim
+    for word in text.lower().split():
+        h = int.from_bytes(hashlib.sha1(word.encode()).digest()[:8], "big")
+        vec[h % dim] += 1.0 if (h >> 63) else -1.0
+    norm = math.sqrt(sum(v * v for v in vec)) or 1.0
+    return [v / norm for v in vec]
+
+
+class KnowledgeBase:
+    def __init__(self, embed_fn):
+        self.embed_fn = embed_fn
+        self.chunks: list[tuple[str, str]] = []   # (source, text)
+        self.vectors: list[list[float]] = []
+
+    def add_file(self, path: str) -> int:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            pieces = chunk_text(f.read())
+        for piece in pieces:
+            self.chunks.append((os.path.basename(path), piece))
+            self.vectors.append(self.embed_fn(piece))
+        return len(pieces)
+
+    def search(self, query: str, k: int = 3):
+        q = self.embed_fn(query)
+        scored = [
+            (sum(a * b for a, b in zip(q, v)), src, text)
+            for v, (src, text) in zip(self.vectors, self.chunks)
+        ]
+        scored.sort(key=lambda s: -s[0])
+        return scored[:k]
+
+
+def model_embedder(model, params, tokenizer):
+    """Mean-pooled final hidden states as the embedding — the in-tree
+    counterpart of AnythingLLM's embedding service."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    def embed(text: str):
+        ids = tokenizer.encode(text)[:256] or [0]
+        h = model.apply({"params": params}, jnp.asarray([ids], jnp.int32),
+                        deterministic=True, return_hidden=True)
+        vec = np.asarray(h[0].mean(axis=0), np.float64)
+        return list(vec / (np.linalg.norm(vec) or 1.0))
+
+    return embed
+
+
+# --- the chat loop -----------------------------------------------------------
+
+
+def build_rag_prompt(question: str, hits) -> list[dict]:
+    context = "\n\n".join(f"[{src}] {text}" for _, src, text in hits)
+    return [
+        {"role": "system",
+         "content": "Answer using ONLY the provided context. Cite the "
+                    f"source file in brackets.\n\nContext:\n{context}"},
+        {"role": "user", "content": question},
+    ]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--kb", default=None, nargs="*",
+                   help="files/dirs to index (default: docs/tutorials)")
+    p.add_argument("--ask", default=None, help="one-shot question")
+    p.add_argument("--top_k", type=int, default=3)
+    p.add_argument("--embedder", choices=["hash", "model"], default="hash")
+    p.add_argument("--model_path", default=None,
+                   help="checkpoint for grounded generation (omit for "
+                        "retrieval-only)")
+    p.add_argument("--tokenizer_path", default="/tmp/qwen3_sft_bpe.json")
+    p.add_argument("--max_new_tokens", type=int, default=128)
+    args = p.parse_args()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sources = args.kb or [os.path.join(repo, "docs", "tutorials")]
+
+    if args.embedder == "model" and not args.model_path:
+        p.error("--embedder model requires --model_path")
+
+    model = params = tok = None
+    if args.model_path or args.embedder == "model":
+        from llm_in_practise_tpu import ckpt
+        from llm_in_practise_tpu.data import BPETokenizer
+        from llm_in_practise_tpu.models import Qwen3, Qwen3Config
+
+        tok = BPETokenizer.load(args.tokenizer_path)
+        params, meta = ckpt.restore_checkpoint(args.model_path)
+        model = Qwen3(Qwen3Config.from_dict(meta["config"]))
+
+    embed_fn = (model_embedder(model, params, tok)
+                if args.embedder == "model" else hash_embed)
+    kb = KnowledgeBase(embed_fn)
+    n = 0
+    for src in sources:
+        if os.path.isdir(src):
+            for name in sorted(os.listdir(src)):
+                if name.endswith((".md", ".txt")):
+                    n += kb.add_file(os.path.join(src, name))
+        else:
+            n += kb.add_file(src)
+    print(f"indexed {n} chunks from {len(sources)} source(s)")
+
+    def answer(question: str):
+        hits = kb.search(question, k=args.top_k)
+        for score, src, text in hits:
+            print(f"  [{score:+.3f}] {src}: {text[:80]}...")
+        if model is None or args.model_path is None:
+            return
+        from llm_in_practise_tpu.data.sft import render_chatml
+        from llm_in_practise_tpu.infer.generate import generate
+        import jax.numpy as jnp
+
+        prompt = render_chatml(build_rag_prompt(question, hits))
+        prompt += "\n<|im_start|>assistant\n"
+        ids = tok.encode(prompt)
+        out = generate(model, params, jnp.asarray([ids], jnp.int32),
+                       max_new_tokens=args.max_new_tokens, greedy=True)
+        print(tok.decode(list(out[0, len(ids):])))
+
+    if args.ask:
+        answer(args.ask)
+        return
+    print("interactive RAG chat — empty line to exit")
+    while True:
+        try:
+            q = input("? ").strip()
+        except EOFError:
+            break
+        if not q:
+            break
+        answer(q)
+
+
+if __name__ == "__main__":
+    main()

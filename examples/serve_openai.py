@@ -62,6 +62,11 @@ def main():
                    help="share prefix KV through a kv_pool server at "
                         "HOST:PORT (LMCache lm:// parity; start one with "
                         "python -m llm_in_practise_tpu.serve.kv_pool)")
+    p.add_argument("--speculative", dest="speculative", type=int,
+                   nargs="?", const=4, default=None, metavar="K",
+                   help="ngram/prompt-lookup speculative decoding: draft K "
+                        "tokens per step, verify in one forward (lossless "
+                        "for greedy; vLLM ngram speculator parity)")
     args = p.parse_args()
 
     tok = BPETokenizer.load(args.tokenizer_path)
@@ -83,8 +88,12 @@ def main():
         params = shard_fn(params)
         print(f"tensor parallel over {args.tp} devices")
 
-    kv_pool = None
-    if args.kv_offload or args.kv_remote:
+    # KV is only valid under the weights that produced it, so every served
+    # model (base + each adapter) gets its OWN tiered pool; the remote
+    # server is shared but namespaced per model name (LMCache semantics).
+    def make_kv_pool(model_name):
+        if not (args.kv_offload or args.kv_remote):
+            return None
         from llm_in_practise_tpu.serve.kv_pool import (
             HostKVPool, RemoteKVClient, TieredKV,
         )
@@ -92,19 +101,24 @@ def main():
         remote = None
         if args.kv_remote:
             rhost, rport = args.kv_remote.rsplit(":", 1)
-            remote = RemoteKVClient((rhost, int(rport)))
-        kv_pool = TieredKV(HostKVPool(), remote)
-        tiers = "HBM->host" + ("->remote" if remote else "")
-        print(f"tiered KV pool: {tiers}")
+            remote = RemoteKVClient((rhost, int(rport)),
+                                    namespace=model_name)
+        return TieredKV(HostKVPool(), remote)
+
+    if args.kv_offload or args.kv_remote:
+        tiers = "HBM->host" + ("->remote" if args.kv_remote else "")
+        print(f"tiered KV pool: {tiers} (namespaced per model)")
 
     engine_kw = dict(
         max_slots=args.max_slots, cache_len=args.cache_len,
         eos_id=tok.token_to_id(IM_END), cache_dtype=jnp.float32,
         prefix_cache=args.prefix_caching,
         chunked_prefill=args.chunked_prefill, mesh=mesh,
-        kv_pool=kv_pool,
+        speculative_k=args.speculative,
     )
-    engine = InferenceEngine(model, params, **engine_kw)
+    engine = InferenceEngine(model, params,
+                             kv_pool=make_kv_pool(args.model_name),
+                             **engine_kw)
     adapters = {}
     if args.lora_modules:
         from llm_in_practise_tpu.serve.adapters import (
@@ -114,7 +128,9 @@ def main():
 
         adapters = build_adapter_engines(
             model, params, parse_lora_modules(args.lora_modules),
-            param_transform=shard_fn, **engine_kw
+            param_transform=shard_fn,
+            engine_kw_for=lambda name: {"kv_pool": make_kv_pool(name)},
+            **engine_kw
         )
         print(f"adapters: {sorted(adapters)}")
     server = OpenAIServer(engine, tok, model_name=args.model_name,

@@ -227,6 +227,7 @@ class Qwen3(nn.Module):
         deterministic: bool = True,  # accepted for train-step compatibility
         cache: list[Cache] | None = None,
         positions: jax.Array | None = None,
+        return_hidden: bool = False,  # final-norm hidden states (embedder use)
     ):
         cfg = self.cfg
         compute_dtype = jnp.dtype(cfg.compute_dtype)
@@ -248,6 +249,8 @@ class Qwen3(nn.Module):
             if new_caches is not None:
                 new_caches.append(layer_cache)
         x = RMSNorm(cfg.rms_norm_eps, name="ln_f")(x)
+        if return_hidden:
+            return x
         if cfg.tie_word_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:

@@ -51,6 +51,7 @@ def build_adapter_engines(
     base_params,
     modules: dict[str, str],
     param_transform=None,
+    engine_kw_for=None,
     **engine_kw,
 ) -> dict[str, InferenceEngine]:
     """One engine per adapter name, merged weights, shared model/config.
@@ -59,12 +60,19 @@ def build_adapter_engines(
     params — e.g. :func:`..serve.engine.shard_params_for_serving` so
     adapters follow the base engine's tensor-parallel placement instead of
     replicating host arrays onto every mesh device.
+
+    ``engine_kw_for(name)`` (optional) returns per-adapter kwargs merged
+    over ``engine_kw`` — needed for anything that must NOT be shared
+    across weight sets, like a ``kv_pool`` (each adapter's KV is only
+    valid under its own merged weights).
     """
     def prep(path):
         merged = load_adapter(base_params, path)
         return param_transform(merged) if param_transform else merged
 
     return {
-        name: InferenceEngine(model, prep(path), **engine_kw)
+        name: InferenceEngine(
+            model, prep(path),
+            **{**engine_kw, **(engine_kw_for(name) if engine_kw_for else {})})
         for name, path in modules.items()
     }

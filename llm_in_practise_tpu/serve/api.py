@@ -11,6 +11,8 @@ Parity with the reference's FastAPI server
   :func:`llm_in_practise_tpu.data.sft.render_chatml` plus the generation
   prompt suffix.
 - usage accounting (``:118-152``), ``GET /v1/models``, ``GET /health``.
+- ``POST /v1/embeddings`` — mean-pooled hidden states (the embedding
+  service the reference's semantic cache / RAG stack call out to).
 - ``GET /metrics`` — Prometheus text exposition with the platform's canonical
   serving metrics (queue depth, running requests, TTFT/TPOT quantiles —
   mirroring the PromQL table ``LLM_on_Kubernetes/Inference_Platfrom/
@@ -69,6 +71,7 @@ class OpenAIServer:
         # request's ``model`` field (see serve/adapters.py).
         self.adapters = dict(adapters or {})
         self._httpd: ThreadingHTTPServer | None = None
+        self._embed_fn = None  # lazily jitted /v1/embeddings pooler
 
     def engine_for(self, model: str | None) -> InferenceEngine | None:
         if model in (None, "", self.model_name):
@@ -76,6 +79,75 @@ class OpenAIServer:
         return self.adapters.get(model)
 
     # --- request handling ----------------------------------------------------
+
+    def handle_embeddings(self, body: dict, send_json):
+        """``POST /v1/embeddings`` — OpenAI embeddings schema over
+        mean-pooled final hidden states (``return_hidden``). This is the
+        in-tree counterpart of the embedding service the reference's
+        semantic cache and RAG stack call out to."""
+        import jax
+        import jax.numpy as jnp
+
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        def _ok(x):
+            if isinstance(x, str):
+                return True
+            return (isinstance(x, list)
+                    and all(isinstance(t, int) for t in x))
+
+        if not isinstance(inputs, list) or not inputs or not all(
+                _ok(x) for x in inputs):
+            return send_json(422, {"error": {
+                "message": "input must be a string, list of strings, or "
+                           "list of integer token lists",
+                "type": "invalid_request_error"}})
+        engine = self.engine_for(body.get("model"))
+        if engine is None:
+            return send_json(404, {"error": {
+                "message": f"model {body.get('model')!r} not found",
+                "type": "invalid_request_error"}})
+
+        if self._embed_fn is None:
+            model = engine.model
+
+            def embed(params, ids, length):
+                h = model.apply({"params": params}, ids,
+                                deterministic=True, return_hidden=True)
+                mask = (jnp.arange(ids.shape[1]) < length)[None, :, None]
+                pooled = (h * mask).sum(axis=1) / jnp.maximum(length, 1)
+                return pooled[0].astype(jnp.float32)
+
+            self._embed_fn = jax.jit(embed)
+
+        data, total = [], 0
+        for i, item in enumerate(inputs):
+            ids = (list(item) if isinstance(item, list)
+                   else self.tokenizer.encode(item))
+            ids = ids[: engine.cache_len] or [0]
+            total += len(ids)
+            bucket = engine._bucket_for(len(ids))  # reuse prefill buckets
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : len(ids)] = ids
+            try:
+                vec = np.asarray(self._embed_fn(
+                    engine.params, jnp.asarray(padded),
+                    jnp.asarray(len(ids), jnp.int32)), np.float64)
+            except TypeError:
+                return send_json(501, {"error": {
+                    "message": "this model does not expose hidden states "
+                               "(return_hidden)",
+                    "type": "unsupported_error"}})
+            norm = float(np.linalg.norm(vec)) or 1.0
+            data.append({"object": "embedding", "index": i,
+                         "embedding": (vec / norm).tolist()})
+        return send_json(200, {
+            "object": "list",
+            "data": data,
+            "model": body.get("model") or self.model_name,
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        })
 
     def handle_chat(self, body: dict, send_json, send_stream):
         try:
@@ -166,6 +238,13 @@ class OpenAIServer:
                 "# TYPE llm_prefix_cache_tokens gauge",
                 f"llm_prefix_cache_tokens {pc.cached_tokens}",
             ]
+        if self.engine.speculative_k is not None:
+            lines += [
+                "# TYPE llm_spec_tokens_proposed_total counter",
+                f"llm_spec_tokens_proposed_total {self.engine.spec_proposed}",
+                "# TYPE llm_spec_tokens_accepted_total counter",
+                f"llm_spec_tokens_accepted_total {self.engine.spec_accepted}",
+            ]
         return "\n".join(lines) + "\n"
 
     # --- HTTP plumbing -------------------------------------------------------
@@ -220,12 +299,15 @@ class OpenAIServer:
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):
-                if self.path not in ("/v1/chat/completions",):
+                if self.path not in ("/v1/chat/completions",
+                                     "/v1/embeddings"):
                     return self._json(404, {"error": {"message": "not found"}})
                 body, err = self._read_json()
                 if err:
                     return self._json(400, err)
                 try:
+                    if self.path == "/v1/embeddings":
+                        return server.handle_embeddings(body, self._json)
                     return server.handle_chat(body, self._json, self._sse)
                 except Exception as e:  # noqa: BLE001 — a handler fault must
                     # still answer the client, not drop the connection. If a

@@ -23,9 +23,10 @@ call — the controller doesn't care). Decisions follow Ray's semantics:
   a downscale only after ``downscale_delay_s`` (slow-down, fast-up)
 - always within [min_replicas, max_replicas]; downscale picks idle
   replicas and **drains** them: a victim leaves the router (no new
-  picks) but is only stopped once its in-flight count reaches zero —
-  closing the race where a request selects an upstream in the instant
-  before teardown.
+  picks) and is stopped no earlier than the next tick, once its
+  in-flight count reads zero — a request that selected the upstream in
+  the instant before the swap gets a full metrics interval to register
+  and finish.
 
 ``tick(now)`` is the whole control law — deterministic and clock-injected
 so tests drive it without sleeping; ``start()`` wraps it in a daemon
@@ -82,6 +83,11 @@ class ReplicaAutoscaler:
         self.stop = stop
         self.config = config or AutoscaleConfig()
         self.clock = clock
+        # membership lock shared by every scaler over the same router:
+        # two groups' controllers must not interleave their list swaps
+        # (read-modify-write of router.upstreams would lose updates)
+        self._router_lock = router.__dict__.setdefault(
+            "_membership_lock", threading.Lock())
         # (ts, ongoing) samples inside the look-back window
         self._samples: "deque[tuple[float, float]]" = deque()
         self._want_up_since: float | None = None
@@ -153,7 +159,9 @@ class ReplicaAutoscaler:
                     # router.upstreams without a lock — never mutate the
                     # live list in place
                     if fresh:
-                        self.router.upstreams = self.router.upstreams + fresh
+                        with self._router_lock:
+                            self.router.upstreams = (
+                                self.router.upstreams + fresh)
                         self.upscales += len(fresh)
                 return len(fresh) - reaped
 
@@ -173,11 +181,15 @@ class ReplicaAutoscaler:
                 )[: current - desired]
                 if victims:
                     gone = set(map(id, victims))
-                    # atomic list swap (see upscale)
-                    self.router.upstreams = [
-                        u for u in self.router.upstreams if id(u) not in gone]
+                    with self._router_lock:  # atomic swap (see upscale)
+                        self.router.upstreams = [
+                            u for u in self.router.upstreams
+                            if id(u) not in gone]
                     self._draining.extend(victims)
-                return -(reaped + self._reap_drained())
+                # newly drained victims are reaped no earlier than the NEXT
+                # tick: a request thread that picked the victim just before
+                # the swap gets one metrics interval to bump pending
+                return -reaped
 
             self._want_up_since = None
             self._want_down_since = None

@@ -71,6 +71,33 @@ class Upstream:
                 self.fails = 0
 
 
+class _StreamHandle:
+    """An open upstream SSE response that releases its replica's pending
+    count on close — the stream's lifetime, not its connection setup,
+    is what occupies the replica."""
+
+    def __init__(self, resp, release):
+        self._resp = resp
+        self._release = release
+        self.headers = resp.headers
+        self.status = resp.status
+
+    def read(self, n: int = -1):
+        return self._resp.read(n)
+
+    def close(self):
+        release, self._release = self._release, None
+        if release is not None:
+            release()
+        self._resp.close()
+
+    def __del__(self):  # backstop: a dropped handle must not leak pending
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class RouterError(Exception):
     pass
 
@@ -331,9 +358,11 @@ class Gateway:
     def _forward(self, upstream: Upstream, body: dict,
                  stream: bool = False) -> tuple[int, object]:
         """POST to one upstream. Non-stream: (status, parsed-JSON dict).
-        Stream success: (200, open http response) — the caller relays the
-        SSE bytes and closes it; ``pending`` then only tracks connection
-        setup, not stream lifetime."""
+        Stream success: (200, stream handle) — the caller relays the SSE
+        bytes and closes it; ``pending`` is held until that close, so the
+        replica counts as busy for the stream's whole lifetime (the
+        autoscaler's drain check and least-pending routing both rely on
+        this)."""
         payload = dict(body, model=upstream.model)
         req = urllib.request.Request(
             f"{upstream.base_url}/v1/chat/completions",
@@ -343,10 +372,17 @@ class Gateway:
         with upstream.lock:
             upstream.pending += 1
             upstream.served += 1
+        handed_off = False
         try:
             if stream:
                 r = urllib.request.urlopen(req, timeout=self.timeout_s)
-                return r.status, r
+
+                def release():
+                    with upstream.lock:
+                        upstream.pending -= 1
+
+                handed_off = True
+                return r.status, _StreamHandle(r, release)
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return r.status, json.loads(r.read())
         except urllib.error.HTTPError as e:
@@ -358,8 +394,9 @@ class Gateway:
         except (urllib.error.URLError, TimeoutError, OSError) as e:
             return 0, {"error": {"message": f"upstream unreachable: {e}"}}
         finally:
-            with upstream.lock:
-                upstream.pending -= 1
+            if not handed_off:
+                with upstream.lock:
+                    upstream.pending -= 1
 
     def _estimate_tokens(self, body: dict) -> int:
         chars = sum(len(str(m.get("content", "")))

@@ -189,16 +189,20 @@ class KVPoolServer:
     """Shared prefix-KV pool over TCP (reference: the LMCache server the
     statefulset points ``LMCACHE_REMOTE_URL: lm://...`` at).
 
-    Keys are token tuples; ``get`` performs the longest-strict-prefix
-    match server-side so clients need one round-trip. LRU by tokens."""
+    Keys are token tuples **namespaced by model identity** (the ``ns``
+    header) — KV rows are only valid under the weights that produced
+    them, so a base model and its LoRA adapters, or two different served
+    models, must never cross-hit (LMCache namespaces the same way).
+    ``get`` performs the longest-strict-prefix match server-side so
+    clients need one round-trip. LRU by tokens, budgeted per namespace."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  max_tokens: int = 1 << 22, min_prefix: int = 16):
         self.min_prefix = min_prefix
         self.max_tokens = max_tokens
-        # values are (length, bucket, blob) tuples in the shared store
-        self._store = PrefixLRU(max_tokens=max_tokens, min_prefix=min_prefix,
-                                length_of=lambda v: v[0])
+        # one store per namespace; values are (length, bucket, blob)
+        self._stores: dict[str, PrefixLRU] = {}
+        self._stores_lock = threading.Lock()
         pool = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -229,14 +233,24 @@ class KVPoolServer:
 
     # -- ops ----------------------------------------------------------------
 
+    def _store_for(self, ns: str) -> PrefixLRU:
+        with self._stores_lock:
+            store = self._stores.get(ns)
+            if store is None:
+                store = self._stores[ns] = PrefixLRU(
+                    max_tokens=self.max_tokens, min_prefix=self.min_prefix,
+                    length_of=lambda v: v[0])
+            return store
+
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         op = header.get("op")
+        ns = str(header.get("ns", ""))
         if op == "put":
-            self._put(tuple(header["key"]), int(header["length"]),
+            self._put(ns, tuple(header["key"]), int(header["length"]),
                       int(header["bucket"]), payload)
             _send_msg(sock, {"ok": True})
         elif op == "get":
-            found = self._get(tuple(header["prompt"]))
+            found = self._get(ns, tuple(header["prompt"]))
             if found is None:
                 _send_msg(sock, {"found": False})
             else:
@@ -244,40 +258,59 @@ class KVPoolServer:
                 _send_msg(sock, {"found": True, "length": length,
                                  "bucket": bucket}, blob)
         elif op == "stats":
+            with self._stores_lock:
+                stores = list(self._stores.values())
             _send_msg(sock, {
-                "entries": self._store.n_entries,
-                "cached_tokens": self._store.cached_tokens,
+                "entries": sum(s.n_entries for s in stores),
+                "cached_tokens": sum(s.cached_tokens for s in stores),
                 "hits": self.hits, "misses": self.misses,
+                "namespaces": len(stores),
             })
         else:
             _send_msg(sock, {"ok": False, "error": f"unknown op {op!r}"})
 
     @property
     def hits(self) -> int:
-        return self._store.hits
+        with self._stores_lock:
+            return sum(s.hits for s in self._stores.values())
 
     @property
     def misses(self) -> int:
-        return self._store.misses
+        with self._stores_lock:
+            return sum(s.misses for s in self._stores.values())
 
     @property
     def _entries(self):
-        return self._store._entries
+        """Aggregated view (tests/introspection only)."""
+        merged = {}
+        with self._stores_lock:
+            for ns, store in self._stores.items():
+                for key, value in store._entries.items():
+                    merged[(ns, key)] = value
+        return merged
 
-    def _put(self, key: tuple, length: int, bucket: int, blob: bytes) -> None:
-        self._store.put(list(key), (length, bucket, blob))
+    def _put(self, ns: str, key: tuple, length: int, bucket: int,
+             blob: bytes) -> None:
+        self._store_for(ns).put(list(key), (length, bucket, blob))
 
-    def _get(self, prompt: tuple):
-        return self._store.lookup(prompt)
+    def _get(self, ns: str, prompt: tuple):
+        return self._store_for(ns).lookup(prompt)
 
 
 class RemoteKVClient:
     """One engine's handle on a :class:`KVPoolServer` (connection per call —
-    the pool is hit only on L1+L2 misses and on offload)."""
+    the pool is hit only on L1+L2 misses and on offload).
 
-    def __init__(self, address: tuple[str, int], *, timeout: float = 5.0):
+    ``namespace`` identifies the weights the KV was computed under —
+    every distinct served model (base vs each LoRA adapter, different
+    checkpoints) must use a distinct namespace or cross-model KV rows
+    would be served interchangeably."""
+
+    def __init__(self, address: tuple[str, int], *, timeout: float = 5.0,
+                 namespace: str = ""):
         self.address = tuple(address)
         self.timeout = timeout
+        self.namespace = namespace
 
     def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
         with socket.create_connection(self.address, timeout=self.timeout) as s:
@@ -286,11 +319,13 @@ class RemoteKVClient:
 
     def put(self, prompt_ids, host: HostEntry) -> None:
         key = list(prompt_ids[: host.length])
-        self._call({"op": "put", "key": key, "length": host.length,
-                    "bucket": host.bucket}, encode_entry(host))
+        self._call({"op": "put", "ns": self.namespace, "key": key,
+                    "length": host.length, "bucket": host.bucket},
+                   encode_entry(host))
 
     def get(self, prompt_ids) -> HostEntry | None:
-        header, payload = self._call({"op": "get", "prompt": list(prompt_ids)})
+        header, payload = self._call(
+            {"op": "get", "ns": self.namespace, "prompt": list(prompt_ids)})
         if not header.get("found"):
             return None
         return decode_entry(payload)
@@ -306,17 +341,23 @@ class RemoteKVClient:
 class TieredKV:
     """L2 (+optional L3) behind one lookup/offload surface.
 
+    One TieredKV per served model: KV rows are only meaningful under the
+    weights that produced them, so the host pool must not be shared
+    across models, and the remote client must carry that model's
+    ``namespace``.
+
     ``offload_on_put=True`` (LMCache's streaming write-through) copies
     every finished prefill's entry down the tiers, so a restarting or
     sibling engine starts warm; ``False`` offloads only on L1 eviction.
 
-    Offloads run on a background worker by default (``async_offload``):
-    the device→host transfer and the remote TCP put must not stall the
-    engine's decode loop — a dead pool server would otherwise freeze
-    every running stream for the connect timeout. The queue is bounded;
-    overflow drops the offload (counted in ``dropped``) rather than
-    applying backpressure to serving. ``flush()`` drains the queue —
-    tests and orderly shutdown use it."""
+    The device→host copy and the host-pool insert run synchronously in
+    :meth:`offload` (freeing the HBM the eviction was for); only the
+    remote TCP put runs on a background worker by default
+    (``async_offload``) — a dead pool server must not stall the engine's
+    decode loop for the connect timeout. The queue is bounded and holds
+    host arrays only; overflow drops the remote copy (counted in
+    ``dropped``) rather than applying backpressure to serving.
+    ``flush()`` drains the queue — tests and orderly shutdown use it."""
 
     def __init__(self, host_pool: HostKVPool | None = None,
                  remote: RemoteKVClient | None = None, *,
@@ -348,9 +389,7 @@ class TieredKV:
 
     # -- offload path ---------------------------------------------------------
 
-    def _offload_now(self, prompt_ids, entry) -> None:
-        host = entry_to_host(entry)
-        self.host_pool.put(prompt_ids, host)
+    def _remote_put(self, prompt_ids, host: HostEntry) -> None:
         if self._remote_ok():
             try:
                 self.remote.put(prompt_ids, host)
@@ -359,27 +398,33 @@ class TieredKV:
 
     def _run_worker(self) -> None:
         while True:
-            prompt_ids, entry = self._queue.get()
+            prompt_ids, host = self._queue.get()
             try:
-                self._offload_now(prompt_ids, entry)
+                self._remote_put(prompt_ids, host)
             except Exception:
                 self.remote_errors += 1
             finally:
                 self._queue.task_done()
 
     def offload(self, prompt_ids, entry) -> None:
-        """Device ``PrefixEntry`` -> host pool (+ remote, best-effort)."""
+        """Device ``PrefixEntry`` -> host pool (+ remote, best-effort).
+
+        The device arrays are copied to host here, on the caller's
+        thread — queueing them instead would pin the "evicted" HBM until
+        the worker drained."""
+        host = entry_to_host(entry)
+        self.host_pool.put(prompt_ids, host)
+        if self.remote is None:
+            return
         if self._queue is None:
-            self._offload_now(prompt_ids, entry)
+            self._remote_put(prompt_ids, host)
             return
         if self._worker is None:
             self._worker = threading.Thread(target=self._run_worker,
                                             daemon=True)
             self._worker.start()
         try:
-            # entry.rows are immutable device arrays (sliced copies, never
-            # donated), so deferring the device_get is safe
-            self._queue.put_nowait((list(prompt_ids), entry))
+            self._queue.put_nowait((list(prompt_ids), host))
         except queue.Full:
             self.dropped += 1
 

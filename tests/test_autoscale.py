@@ -70,8 +70,10 @@ def test_downscale_is_slow_and_prefers_idle():
     _load(router, [3, 0, 0])                 # mean 3 → desired 1
     assert scaler.tick(now=0.0) == 0
     assert scaler.tick(now=300.0) == 0       # inside downscale_delay
-    assert scaler.tick(now=601.0) == -2
-    assert router.upstreams == [busy]        # busy replica survived
+    assert scaler.tick(now=601.0) == 0       # victims drained, not stopped
+    assert router.upstreams == [busy]        # ...but already unroutable
+    assert not stopped
+    assert scaler.tick(now=602.0) == -2      # reaped one tick later
     assert len(stopped) == 2
     assert scaler.downscales == 2
 

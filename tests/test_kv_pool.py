@@ -82,6 +82,24 @@ def test_pool_server_roundtrip_and_prefix_match():
         server.stop()
 
 
+def test_pool_server_namespaces_isolate_models():
+    """KV from one model's weights must never be served to another model:
+    same token prefix, different namespace → miss (LMCache semantics)."""
+    server = KVPoolServer(min_prefix=4).start()
+    try:
+        a = RemoteKVClient(server.address, namespace="model-a")
+        b = RemoteKVClient(server.address, namespace="model-b")
+        prompt = list(range(16))
+        a.put(prompt, _host_entry(length=16, bucket=16))
+        assert b.get(prompt) is None          # isolated
+        assert a.get(prompt) is not None      # own namespace hits
+        b.put(prompt, _host_entry(length=16, bucket=16))
+        stats = a.stats()
+        assert stats["entries"] == 2 and stats["namespaces"] == 2
+    finally:
+        server.stop()
+
+
 def test_pool_server_concurrent_clients():
     server = KVPoolServer(min_prefix=4).start()
     try:

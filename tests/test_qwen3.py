@@ -92,6 +92,17 @@ def test_hf_roundtrip_export(hf_ckpt_dir, tmp_path):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
 
 
+def test_return_hidden_states():
+    """return_hidden skips the LM head — the RAG embedder path."""
+    cfg = qwen3_config(vocab_size=64, n_layer=2)
+    model = Qwen3(cfg)
+    rng = jax.random.PRNGKey(0)
+    idx = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    params = model.init_params(rng, 16)
+    hidden = model.apply({"params": params}, idx, return_hidden=True)
+    assert hidden.shape == (2, 16, cfg.hidden_size)
+
+
 def test_tied_embeddings():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

@@ -191,3 +191,33 @@ def test_parse_lora_modules_errors():
     with pytest.raises(ValueError):
         parse_lora_modules(["noequals"])
     assert parse_lora_modules(["a=/p", "b=/q"]) == {"a": "/p", "b": "/q"}
+
+
+def test_embeddings_endpoint(server):
+    """OpenAI /v1/embeddings schema: list input, unit-norm vectors,
+    usage accounting, and the same text embedding identically."""
+    import math
+
+    status, body = _post(server, "/v1/embeddings",
+                         {"input": ["hello world", "hello world", "bye"]})
+    assert status == 200, body
+    out = json.loads(body)
+    assert out["object"] == "list" and len(out["data"]) == 3
+    e0, e1, e2 = (d["embedding"] for d in out["data"])
+    assert [d["index"] for d in out["data"]] == [0, 1, 2]
+    assert abs(sum(x * x for x in e0) - 1.0) < 1e-6     # unit norm
+    assert e0 == e1                                     # deterministic
+    assert e0 != e2
+    assert out["usage"]["prompt_tokens"] == len("hello world") * 2 + 3
+
+
+def test_embeddings_validation(server):
+    status, _ = _post(server, "/v1/embeddings", {"input": 7})
+    assert status == 422
+    status, _ = _post(server, "/v1/embeddings",
+                      {"input": "x", "model": "nope"})
+    assert status == 404
+    # string input is accepted as a singleton
+    status, body = _post(server, "/v1/embeddings", {"input": "just one"})
+    assert status == 200
+    assert len(json.loads(body)["data"]) == 1
