@@ -161,7 +161,7 @@ def main():
             print(f"  [{score:+.3f}] {src}: {text[:80]}...")
         if model is None or args.model_path is None:
             return
-        from llm_in_practise_tpu.data.sft import render_chatml
+        from llm_in_practise_tpu.data.sft import IM_END, render_chatml
         from llm_in_practise_tpu.infer.generate import generate
         import jax.numpy as jnp
 
@@ -169,8 +169,10 @@ def main():
         prompt += "\n<|im_start|>assistant\n"
         ids = tok.encode(prompt)
         out = generate(model, params, jnp.asarray([ids], jnp.int32),
-                       max_new_tokens=args.max_new_tokens, greedy=True)
-        print(tok.decode(list(out[0, len(ids):])))
+                       max_new_tokens=args.max_new_tokens, greedy=True,
+                       eos_id=tok.token_to_id(IM_END))
+        text = tok.decode(list(out[0, len(ids):]))
+        print(text.split(IM_END)[0].strip())
 
     if args.ask:
         answer(args.ask)

@@ -289,27 +289,29 @@ class MoEFeedForward(nn.Module):
             # Training: capacity-based dispatch with first-choice priority —
             # flatten (k, N) slot-major so every token's 1st choice outranks
             # all 2nd choices; overflow tokens are dropped (gate mass lost),
-            # the standard static-shape TPU MoE trade.
+            # the standard static-shape TPU MoE trade. Dispatch/combine are
+            # scatter/gather on (expert, slot) coordinates: each (kN,)
+            # choice owns a unique capacity slot, so no (N, k, E, C)
+            # one-hot tensor is ever materialized (that buffer dominated
+            # both HBM and time at real batch sizes).
             capacity = max(1, int(cfg.capacity_factor * n_tok * k / e))
             flat = sel_onehot.transpose(1, 0, 2).reshape(k * n_tok, e)  # (kN, E)
-            pos_flat = jnp.cumsum(flat, axis=0) * flat - 1.0            # rank in expert
-            pos = pos_flat.reshape(k, n_tok, e).transpose(1, 0, 2)      # (N, k, E)
-            keep = (pos >= 0) & (pos < capacity)
-            pos = jnp.where(keep, pos, 0).astype(jnp.int32)
-            # dispatch[n, k, e, c] — one-hot over capacity slot
-            dispatch = sel_onehot[..., None] * keep[..., None] * jax.nn.one_hot(
-                pos, capacity, dtype=jnp.float32
-            )                                                           # (N, k, E, C)
-            dispatch_nec = dispatch.sum(1)                              # (N, E, C)
-            combine = (dispatch * gate_vals[..., None, None]).sum(1)    # (N, E, C)
-
-            expert_inputs = jnp.einsum(
-                "nec,nd->ecd", dispatch_nec.astype(x.dtype), tokens
-            )
+            # rank of each choice within its expert, priority-ordered
+            slot_f = (jnp.cumsum(flat, axis=0) * flat).sum(-1) - 1.0    # (kN,)
+            keep = (slot_f >= 0) & (slot_f < capacity)                  # (kN,)
+            slot = jnp.where(keep, slot_f, 0).astype(jnp.int32)
+            eid = expert_idx.transpose(1, 0).reshape(-1)                # (kN,)
+            tok_idx = jnp.tile(jnp.arange(n_tok), k)                    # (kN,)
+            contrib = tokens[tok_idx] * keep[:, None].astype(x.dtype)
+            # every kept (eid, slot) pair is unique → add == set
+            expert_inputs = jnp.zeros((e, capacity, d), x.dtype).at[
+                eid, slot].add(contrib)
             expert_out = experts(expert_inputs)                         # (E, C, D)
-            routed = jnp.einsum(
-                "nec,ecd->nd", combine.astype(x.dtype), expert_out
-            )
+            gathered = expert_out[eid, slot]                            # (kN, D)
+            w = (gate_vals.transpose(1, 0).reshape(-1)
+                 * keep.astype(jnp.float32))                            # (kN,)
+            routed = (gathered.reshape(k, n_tok, d)
+                      * w.reshape(k, n_tok, 1).astype(x.dtype)).sum(0)
 
         out = routed.reshape(b, l, d)
         for i in range(cfg.n_shared_experts):

@@ -294,7 +294,11 @@ class KVPoolServer:
         self._store_for(ns).put(list(key), (length, bucket, blob))
 
     def _get(self, ns: str, prompt: tuple):
-        return self._store_for(ns).lookup(prompt)
+        # ns is client-controlled: never allocate a store on lookup, or
+        # probing with varied namespaces grows the server without bound
+        with self._stores_lock:
+            store = self._stores.get(ns)
+        return store.lookup(prompt) if store is not None else None
 
 
 class RemoteKVClient:
