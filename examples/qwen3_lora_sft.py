@@ -75,6 +75,9 @@ def main():
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--max_length", type=int, default=128)
+    p.add_argument("--gradient-checkpointing",
+                   dest="gradient_checkpointing", action="store_true",
+                   help="remat transformer blocks in backward (reference gradient_checkpointing_enable parity)")
     p.add_argument("--adapter_dir", default="/tmp/qwen3_lora_adapter")
     p.add_argument("--tokenizer_path", default="/tmp/qwen3_sft_bpe.json")
     args = p.parse_args()
@@ -86,14 +89,16 @@ def main():
         from llm_in_practise_tpu.models import hf_loader
 
         tok = HFTokenizerAdapter.from_pretrained(args.model_dir)
-        cfg = hf_loader.load_config(args.model_dir)
+        cfg = hf_loader.load_config(args.model_dir).replace(
+            remat=args.gradient_checkpointing)
         model = Qwen3(cfg)
         params = hf_loader.load_qwen3(args.model_dir)[1]
     else:
         tok = build_tokenizer(records, args.name, args.author,
                               args.tokenizer_path)
         cfg = qwen3_config(tok.vocab_size, max_seq_len=args.max_length,
-                           compute_dtype="float32")
+                           compute_dtype="float32",
+                           remat=args.gradient_checkpointing)
         model = Qwen3(cfg)
         params = model.init(
             jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32),

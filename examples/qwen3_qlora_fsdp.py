@@ -55,6 +55,9 @@ def main():
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--batch_size", type=int, default=8)
     p.add_argument("--max_length", type=int, default=128)
+    p.add_argument("--gradient-checkpointing",
+                   dest="gradient_checkpointing", action="store_true",
+                   help="remat transformer blocks in backward (reference gradient_checkpointing_enable parity)")
     p.add_argument("--adapter_dir", default="/tmp/qwen3_qlora_adapter")
     p.add_argument("--tokenizer_path", default="/tmp/qwen3_sft_bpe.json")
     args = p.parse_args()
@@ -65,12 +68,14 @@ def main():
     if args.model_dir:
         from llm_in_practise_tpu.models import hf_loader
 
-        cfg = hf_loader.load_config(args.model_dir)
+        cfg = hf_loader.load_config(args.model_dir).replace(
+            remat=args.gradient_checkpointing)
         model = Qwen3(cfg)
         params = hf_loader.load_qwen3(args.model_dir)[1]
     else:
         cfg = qwen3_config(tok.vocab_size, max_seq_len=args.max_length,
-                           compute_dtype="float32")
+                           compute_dtype="float32",
+                           remat=args.gradient_checkpointing)
         model = Qwen3(cfg)
         params = model.init(
             jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32),

@@ -73,6 +73,7 @@ class DeepSeekConfig:
     activation: str = "gelu"
     attn_impl: str = "auto"
     compute_dtype: str = "float32"
+    remat: bool = False  # gradient checkpointing: recompute blocks in bwd
     cache_mode: str = "latent"  # "latent" (MLA cache) | "full" (k/v cache)
 
     @property
@@ -378,9 +379,19 @@ class DeepSeekLike(nn.Module):
         new_cache = [] if cache is not None else None
         for i in range(cfg.n_layer):
             layer_cache = cache[i] if cache is not None else None
-            x, layer_cache = DeepSeekBlock(
+            block = DeepSeekBlock(
                 cfg, use_moe=i >= cfg.first_dense_layers, name=f"block_{i}"
-            )(x, deterministic=deterministic, cache=layer_cache, positions=positions)
+            )
+            if cfg.remat and cache is None:
+                # gradient checkpointing; the sown MoE aux losses thread
+                # through the lifted remat unchanged (tested)
+                x = layers.remat_apply(
+                    block, x, deterministic=deterministic,
+                    cache=None, positions=positions)
+            else:
+                x, layer_cache = block(
+                    x, deterministic=deterministic, cache=layer_cache,
+                    positions=positions)
             if new_cache is not None:
                 new_cache.append(layer_cache)
 

@@ -41,6 +41,7 @@ class GPTConfig:
     rope_theta: float = 10000.0
     attn_impl: str = "auto"
     compute_dtype: str = "float32"
+    remat: bool = False  # gradient checkpointing: recompute blocks in bwd
 
     def replace(self, **kw) -> "GPTConfig":
         return dataclasses.replace(self, **kw)
@@ -95,16 +96,26 @@ class GPT(nn.Module):
         x = x.astype(compute_dtype)
 
         new_cache = [] if cache is not None else None
+        block_pos = positions if cfg.pos_embedding == "rope" else None
         for i in range(cfg.n_layer):
             layer_cache = cache[i] if cache is not None else None
-            x, layer_cache = layers.TransformerBlock(
+            block = layers.TransformerBlock(
                 cfg.embed_dim, cfg.n_head, cfg.mlp_ratio, cfg.dropout,
                 norm_first=cfg.norm_first, activation=cfg.activation,
                 use_rope=cfg.pos_embedding == "rope",
                 rope_theta=cfg.rope_theta, max_seq_len=cfg.seq_len,
                 attn_impl=cfg.attn_impl, name=f"block_{i}",
-            )(x, deterministic=deterministic, cache=layer_cache,
-              positions=positions if cfg.pos_embedding == "rope" else None)
+            )
+            if cfg.remat and cache is None:
+                # gradient checkpointing (reference
+                # gradient_checkpointing_enable parity)
+                x = layers.remat_apply(
+                    block, x, deterministic=deterministic,
+                    cache=None, positions=block_pos)
+            else:
+                x, layer_cache = block(
+                    x, deterministic=deterministic, cache=layer_cache,
+                    positions=block_pos)
             if new_cache is not None:
                 new_cache.append(layer_cache)
 

@@ -28,6 +28,23 @@ Cache = dict[str, Any]
 dense_init = nn.initializers.normal(stddev=0.02)
 
 
+def remat_apply(block: nn.Module, *args, **call_kwargs):
+    """Apply a transformer block under gradient checkpointing.
+
+    Shared by every model family's ``cfg.remat`` path: wraps the block's
+    ``__call__`` in flax's lifted ``nn.remat`` so activations are
+    recomputed in backward instead of saved (exact — tested in
+    tests/test_remat.py). ``call_kwargs`` are closed over (python bools
+    stay static; traced arrays like ``positions`` become free variables,
+    which ``jax.checkpoint`` handles); the block's cache output is
+    dropped — remat only runs on the cache-free training forward.
+    """
+    def run(mdl, *a):
+        return mdl(*a, **call_kwargs)[0]
+
+    return nn.remat(run, prevent_cse=False)(block, *args)
+
+
 def _activation(name: str):
     return {"gelu": nn.gelu, "relu": nn.relu, "silu": nn.silu}[name]
 

@@ -47,6 +47,7 @@ class Qwen3Config:
     tie_word_embeddings: bool = False
     attn_impl: str = "auto"
     compute_dtype: str = "bfloat16"
+    remat: bool = False  # gradient checkpointing: recompute blocks in bwd
 
     def replace(self, **kw) -> "Qwen3Config":
         return dataclasses.replace(self, **kw)
@@ -243,9 +244,16 @@ class Qwen3(nn.Module):
         new_caches: list[Cache] | None = [] if cache is not None else None
         for i in range(cfg.n_layer):
             layer_cache = cache[i] if cache is not None else None
-            x, layer_cache = Qwen3Block(cfg, name=f"block_{i}")(
-                x, rope_tables, cache=layer_cache, positions=positions
-            )
+            block = Qwen3Block(cfg, name=f"block_{i}")
+            if cfg.remat and cache is None:
+                # gradient checkpointing (the reference fine-tunes all call
+                # gradient_checkpointing_enable — qwen3-8b-lora.py:128-144)
+                x = layers.remat_apply(
+                    block, x, rope_tables, cache=None, positions=positions)
+            else:
+                x, layer_cache = block(
+                    x, rope_tables, cache=layer_cache, positions=positions
+                )
             if new_caches is not None:
                 new_caches.append(layer_cache)
         x = RMSNorm(cfg.rms_norm_eps, name="ln_f")(x)

@@ -203,6 +203,7 @@ class KVPoolServer:
         # one store per namespace; values are (length, bucket, blob)
         self._stores: dict[str, PrefixLRU] = {}
         self._stores_lock = threading.Lock()
+        self._unknown_ns_misses = 0   # gets for namespaces with no store
         pool = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -277,7 +278,8 @@ class KVPoolServer:
     @property
     def misses(self) -> int:
         with self._stores_lock:
-            return sum(s.misses for s in self._stores.values())
+            return (self._unknown_ns_misses
+                    + sum(s.misses for s in self._stores.values()))
 
     @property
     def _entries(self):
@@ -298,7 +300,10 @@ class KVPoolServer:
         # probing with varied namespaces grows the server without bound
         with self._stores_lock:
             store = self._stores.get(ns)
-        return store.lookup(prompt) if store is not None else None
+            if store is None:
+                self._unknown_ns_misses += 1   # cold-start misses count too
+                return None
+        return store.lookup(prompt)
 
 
 class RemoteKVClient:
