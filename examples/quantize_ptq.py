@@ -53,6 +53,7 @@ def main():
         params, meta = ckpt.restore_checkpoint(args.model_path)
         model = Qwen3(Qwen3Config.from_dict(meta["config"]))
         cfg_dict = meta["config"]
+        family = "qwen3"
     else:
         # Hermetic demo: quickly pretrain a small GPT so PPL is meaningful.
         from llm_in_practise_tpu.data import block_chunk, tokenize_corpus
@@ -69,6 +70,9 @@ def main():
         trainer.train((x, y))
         params = jax.device_get(trainer.state.params)
         cfg_dict = model.config.to_dict()
+        family = "gpt"
+        os.makedirs(args.out_dir, exist_ok=True)
+        tok.save(os.path.join(args.out_dir, "tokenizer.json"))
 
     # Calibration set (the reference uses alpaca-gpt4-zh[:128] text concat).
     calib_lines = prepare_data("wikitext-2")[: 50 * args.n_calib]
@@ -117,6 +121,19 @@ def main():
                   "group_size": args.group_size, "ppl": result["quant_ppl"]},
     )
     print(f"quantized model -> {path}")
+
+    # packed export: weights stay 4-bit on disk AND at serve time (the
+    # compressed-tensors artifact vLLM consumes); serve it with
+    # examples/serve_openai.py --quantized_dir <dir>/packed
+    from llm_in_practise_tpu.quant import io as quant_io
+
+    packed_path = quant_io.save_packed(
+        os.path.join(args.out_dir, "packed"), qparams,
+        metadata={"config": cfg_dict, "family": family,
+                  "method": args.method, "group_size": args.group_size,
+                  "ppl": result["quant_ppl"]},
+    )
+    print(f"packed (4-bit) export -> {packed_path}")
 
 
 if __name__ == "__main__":

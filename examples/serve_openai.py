@@ -67,12 +67,40 @@ def main():
                    help="ngram/prompt-lookup speculative decoding: draft K "
                         "tokens per step, verify in one forward (lossless "
                         "for greedy; vLLM ngram speculator parity)")
+    p.add_argument("--quantized_dir", default=None,
+                   help="serve a packed 4-bit export from "
+                        "examples/quantize_ptq.py (weights stay packed in "
+                        "HBM, fused dequant matmuls — vLLM "
+                        "compressed-tensors serving parity)")
     args = p.parse_args()
 
+    if args.quantized_dir and args.tp > 1:
+        p.error("--tensor-parallel-size with --quantized_dir is not "
+                "supported yet (packed leaves have no TP shardings)")
+    if args.quantized_dir and args.lora_modules:
+        p.error("--lora-modules with --quantized_dir is not supported "
+                "(adapters cannot merge into packed 4-bit kernels)")
+
     tok = BPETokenizer.load(args.tokenizer_path)
-    params, meta = ckpt.restore_checkpoint(args.model_path)
-    model = Qwen3(Qwen3Config.from_dict(meta["config"]))
-    print(f"model: {args.model_path} | devices: {jax.devices()}")
+    if args.quantized_dir:
+        from llm_in_practise_tpu.quant import io as quant_io
+        from llm_in_practise_tpu.serve.quantized import QuantizedModel
+
+        params, meta = quant_io.load_packed(args.quantized_dir)
+        if meta.get("family") == "gpt":  # the hermetic PTQ demo's model
+            from llm_in_practise_tpu.models import GPT, GPTConfig
+
+            base = GPT(GPTConfig.from_dict(meta["config"]))
+        else:
+            base = Qwen3(Qwen3Config.from_dict(meta["config"]))
+        model = QuantizedModel(base)
+        print(f"packed 4-bit model: {args.quantized_dir} "
+              f"({meta.get('method')}, ppl {meta.get('ppl')}) "
+              f"| devices: {jax.devices()}")
+    else:
+        params, meta = ckpt.restore_checkpoint(args.model_path)
+        model = Qwen3(Qwen3Config.from_dict(meta["config"]))
+        print(f"model: {args.model_path} | devices: {jax.devices()}")
 
     from llm_in_practise_tpu.data.sft import IM_END
 

@@ -1,0 +1,113 @@
+"""Packed quantized-checkpoint I/O — the export format the serving path
+consumes.
+
+The reference serves quantized models from on-disk packed formats (vLLM
+loading ``compressed-tensors`` / GPTQModel artifacts —
+``Quantization/LLM-Compressor/GPTQ/eval_qwen3_4b_gptq.py:11-21``); weights
+stay 4-bit on disk and in memory. Here the analog: a param tree whose
+kernel leaves are :class:`~llm_in_practise_tpu.quant.int4.Int4Tensor` /
+:class:`~llm_in_practise_tpu.quant.awq.AWQTensor` /
+:class:`~llm_in_practise_tpu.quant.nf4.NF4Tensor` round-trips through one
+``.npz`` (all component arrays) plus a JSON manifest (leaf types + static
+aux). Loading rebuilds the exact pytree — ready for
+:func:`~llm_in_practise_tpu.peft.fused.fused_quant_apply` or the serving
+adapter, with no bf16 materialization anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from llm_in_practise_tpu.quant.awq import AWQTensor
+from llm_in_practise_tpu.quant.int4 import Int4Tensor
+from llm_in_practise_tpu.quant.nf4 import NF4Tensor
+from llm_in_practise_tpu.utils.tree import path_str
+
+_QUANT_TYPES = (Int4Tensor, AWQTensor, NF4Tensor)
+
+
+def _is_quant(v) -> bool:
+    return isinstance(v, _QUANT_TYPES)
+
+
+def _leaf_entries(key: str, leaf):
+    """(manifest_entry, {array_name: np.ndarray}) for one tree leaf."""
+    if isinstance(leaf, Int4Tensor):
+        return (
+            {"type": "int4", "group_size": leaf.group_size,
+             "shape": list(leaf.shape)},
+            {f"{key}#packed": leaf.packed, f"{key}#scales": leaf.scales,
+             f"{key}#zeros": leaf.zeros},
+        )
+    if isinstance(leaf, AWQTensor):
+        inner, arrays = _leaf_entries(key, leaf.q)
+        arrays[f"{key}#inv_scale"] = leaf.inv_scale
+        return {"type": "awq", "int4": inner}, arrays
+    if isinstance(leaf, NF4Tensor):
+        return (
+            {"type": "nf4", "shape": list(leaf.shape),
+             "layout": leaf.layout},
+            {f"{key}#packed": leaf.packed, f"{key}#absmax_q": leaf.absmax_q,
+             f"{key}#absmax_scale": leaf.absmax_scale,
+             f"{key}#absmax_offset": leaf.absmax_offset},
+        )
+    return {"type": "array"}, {key: leaf}
+
+
+def _rebuild_leaf(entry: dict, key: str, arrays) -> object:
+    import jax.numpy as jnp
+
+    def arr(name):
+        return jnp.asarray(arrays[f"{key}#{name}"])
+
+    if entry["type"] == "int4":
+        return Int4Tensor(arr("packed"), arr("scales"), arr("zeros"),
+                          group_size=entry["group_size"],
+                          shape=tuple(entry["shape"]))
+    if entry["type"] == "awq":
+        return AWQTensor(_rebuild_leaf(entry["int4"], key, arrays),
+                         arr("inv_scale"))
+    if entry["type"] == "nf4":
+        return NF4Tensor(arr("packed"), arr("absmax_q"), arr("absmax_scale"),
+                         arr("absmax_offset"), shape=tuple(entry["shape"]),
+                         layout=entry["layout"])
+    return jnp.asarray(arrays[key])
+
+
+def save_packed(out_dir: str, qtree, *, metadata: dict | None = None) -> str:
+    """Write a packed quantized tree; returns the manifest path."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest: dict = {"leaves": {}, "metadata": metadata or {}}
+    arrays: dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            qtree, is_leaf=_is_quant):
+        key = path_str(path)
+        entry, leaf_arrays = _leaf_entries(key, leaf)
+        manifest["leaves"][key] = entry
+        arrays.update({k: np.asarray(jax.device_get(v))
+                       for k, v in leaf_arrays.items()})
+    np.savez(os.path.join(out_dir, "packed.npz"), **arrays)
+    mpath = os.path.join(out_dir, "manifest.json")
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+    return mpath
+
+
+def load_packed(out_dir: str):
+    """Read a packed tree back: ``(qtree, metadata)``."""
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    with np.load(os.path.join(out_dir, "packed.npz")) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+    tree: dict = {}
+    for key, entry in manifest["leaves"].items():
+        node = tree
+        parts = key.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = _rebuild_leaf(entry, key, arrays)
+    return tree, manifest["metadata"]

@@ -1,0 +1,46 @@
+"""Serving packed quantized weights — the W4A16 inference path.
+
+The reference serves its GPTQ/AWQ exports through vLLM
+(``quantization="compressed-tensors"`` —
+``Quantization/LLM-Compressor/GPTQ/eval_qwen3_4b_gptq.py:11-21``): weights
+stay 4-bit in GPU memory and dequantize inside the matmul kernels. Here
+:class:`QuantizedModel` gives the continuous-batching engine the same
+property: it walks like a model (``apply`` / ``init_cache`` / ``config``)
+but its "params" tree carries packed
+Int4/AWQ/NF4 leaves, and every Dense runs through the fused Pallas
+dequant-matmuls (:func:`~llm_in_practise_tpu.peft.fused.fused_quant_apply`)
+— the bf16 weight copy never exists in HBM.
+
+Usage::
+
+    qtree, meta = quant_io.load_packed(dir)          # 4-bit on disk
+    engine = InferenceEngine(QuantizedModel(model), qtree, ...)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.peft.fused import fused_quant_apply
+
+
+class QuantizedModel:
+    """Model facade: ``apply({"params": qtree}, ...)`` serves the packed
+    tree through the fused kernels; everything else delegates."""
+
+    def __init__(self, model, *, compute_dtype=jnp.bfloat16):
+        self.model = model
+        self.compute_dtype = compute_dtype
+
+    @property
+    def config(self):
+        return self.model.config
+
+    def init_cache(self, *args, **kwargs):
+        return self.model.init_cache(*args, **kwargs)
+
+    def apply(self, variables, *args, **kwargs):
+        return fused_quant_apply(
+            self.model, variables["params"], *args,
+            compute_dtype=self.compute_dtype, **kwargs,
+        )

@@ -25,7 +25,7 @@ def _tree_allclose(a, b, rtol=1e-5, atol=1e-6):
                                    rtol=rtol, atol=atol)
 
 
-def _loss_and_grads(model, params, x, y, loss_extra=None):
+def _loss_and_grads(model, params, x, y):
     def loss_fn(p):
         logits = model.apply({"params": p}, x, deterministic=True)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
