@@ -67,6 +67,10 @@ def main():
                    help="ngram/prompt-lookup speculative decoding: draft K "
                         "tokens per step, verify in one forward (lossless "
                         "for greedy; vLLM ngram speculator parity)")
+    p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype",
+                   default="float32", choices=["float32", "bfloat16", "fp8"],
+                   help="KV cache storage dtype; fp8 (e4m3) halves KV HBM "
+                        "vs bf16 (vLLM --kv-cache-dtype fp8 parity)")
     p.add_argument("--quantized_dir", default=None,
                    help="serve a packed 4-bit export from "
                         "examples/quantize_ptq.py (weights stay packed in "
@@ -139,7 +143,9 @@ def main():
 
     engine_kw = dict(
         max_slots=args.max_slots, cache_len=args.cache_len,
-        eos_id=tok.token_to_id(IM_END), cache_dtype=jnp.float32,
+        eos_id=tok.token_to_id(IM_END),
+        cache_dtype={"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                     "fp8": jnp.float8_e4m3fn}[args.kv_cache_dtype],
         prefix_cache=args.prefix_caching,
         chunked_prefill=args.chunked_prefill, mesh=mesh,
         speculative_k=args.speculative,

@@ -28,6 +28,25 @@ def _ref_greedy(model, params, prompt, n):
     return list(np.asarray(out[0, len(prompt):]))
 
 
+def test_fp8_kv_cache_serves(rng):
+    """fp8 (e4m3) KV storage — half of bf16's KV HBM — must decode
+    cleanly: right lengths, in-vocab tokens, quantization noise only."""
+    model, params = _tiny_model(rng)
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128,
+        cache_dtype=jnp.float8_e4m3fn,
+    )
+    assert engine.cache[0]["k"].dtype == jnp.float8_e4m3fn
+    out = engine.generate(list(range(1, 17)),
+                          SamplingParams(greedy=True, max_tokens=12))
+    assert len(out) == 12
+    assert all(0 <= t < 64 for t in out)
+    # storage really is 1 byte/element (vs 4 for the f32 reference cache)
+    ref = InferenceEngine(model, params, max_slots=2, cache_len=128,
+                          cache_dtype=jnp.float32)
+    assert engine.cache[0]["k"].nbytes * 4 == ref.cache[0]["k"].nbytes
+
+
 def test_single_request_matches_generate(rng):
     model, params = _tiny_model(rng)
     engine = InferenceEngine(
