@@ -32,5 +32,80 @@ def cross_entropy(
     return loss, n_valid
 
 
+def fused_linear_cross_entropy(
+    hidden: jax.Array,
+    weight: jax.Array,
+    labels: jax.Array,
+    *,
+    transpose_weight: bool = False,
+    bias: jax.Array | None = None,
+    ignore_index: int = IGNORE_INDEX,
+    chunk: int = 4096,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array]:
+    """LM-head projection fused into the loss — full logits never exist.
+
+    The naive path materializes ``(batch, seq, vocab)`` f32 logits twice
+    (log-softmax + its backward): at GPTLike scale that is 16 GB for one
+    batch-512 step — larger than a v5e chip's whole HBM. Here tokens are
+    processed in ``chunk``-sized slabs under a ``lax.scan``: each slab runs
+    ``hidden_chunk @ weight`` on the MXU (bf16 in, f32 accumulation), reduces
+    to per-token NLL, and is rematerialized in the backward
+    (``jax.checkpoint``), so peak vocab-axis memory is ``chunk × vocab``
+    regardless of batch. Same role as the reference's fused CE in its CUDA
+    stack (torch ``nn.CrossEntropyLoss`` over flattened logits,
+    ``minigpt2/model.py:104``) but restructured for HBM, not translated.
+
+    hidden: (..., dim); weight: (dim, vocab), or (vocab, dim) with
+    ``transpose_weight=True`` (tied-embedding ``attend`` layout);
+    labels: (...) int with ``ignore_index`` masked out.
+    Returns (mean_nll, n_valid_tokens).
+    """
+    dim = hidden.shape[-1]
+    flat_h = hidden.reshape(-1, dim)
+    flat_l = labels.reshape(-1)
+    n_tok = flat_h.shape[0]
+    chunk = min(chunk, n_tok)
+    pad = -n_tok % chunk
+    if pad:
+        flat_h = jnp.concatenate(
+            [flat_h, jnp.zeros((pad, dim), flat_h.dtype)])
+        flat_l = jnp.concatenate(
+            [flat_l, jnp.full((pad,), ignore_index, flat_l.dtype)])
+    n_chunks = flat_h.shape[0] // chunk
+    h_c = flat_h.reshape(n_chunks, chunk, dim)
+    l_c = flat_l.reshape(n_chunks, chunk)
+
+    w = weight.astype(compute_dtype)
+
+    @jax.checkpoint
+    def chunk_nll(w, b, hc, lb):
+        contract = ((1,), (1,)) if transpose_weight else ((1,), (0,))
+        logits = jax.lax.dot_general(
+            hc.astype(compute_dtype), w, (contract, ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if b is not None:
+            logits = logits + b.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = lb != ignore_index
+        safe = jnp.where(valid, lb, 0)
+        tgt = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        return ((lse - tgt) * valid).sum(), valid.sum()
+
+    def body(carry, xs):
+        hc, lb = xs
+        nll, nv = chunk_nll(w, bias, hc, lb)
+        return (carry[0] + nll, carry[1] + nv), None
+
+    (total, n_valid), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (h_c, l_c),
+    )
+    n_valid = jnp.maximum(n_valid, 1)
+    return total / n_valid, n_valid
+
+
 def perplexity(mean_nll: jax.Array) -> jax.Array:
     return jnp.exp(mean_nll)

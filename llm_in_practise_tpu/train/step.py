@@ -12,10 +12,14 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import optax
 from flax.training import train_state
 
-from llm_in_practise_tpu.train.losses import cross_entropy
+from llm_in_practise_tpu.train.losses import (
+    cross_entropy,
+    fused_linear_cross_entropy,
+)
 
 
 class TrainState(train_state.TrainState):
@@ -26,6 +30,39 @@ class TrainState(train_state.TrainState):
 
 def create_train_state(model, params, tx, rng) -> TrainState:
     return TrainState.create(apply_fn=model.apply, params=params, tx=tx, rng=rng)
+
+
+def head_weight(params) -> tuple[jax.Array, bool, jax.Array | None]:
+    """(LM-head weight, transpose?, bias) from a params tree —
+    ``lm_head/kernel`` (dim, vocab) when untied, else the tied
+    ``tok_embed/embedding`` (vocab, dim). Shared naming across every
+    in-tree model family."""
+    if "lm_head" in params:
+        return (params["lm_head"]["kernel"], False,
+                params["lm_head"].get("bias"))
+    return params["tok_embed"]["embedding"], True, None
+
+
+def make_fused_ce_loss(*, chunk: int = 4096, compute_dtype="bfloat16") -> Callable:
+    """Next-token loss with the LM-head projection fused into the CE
+    (:func:`..train.losses.fused_linear_cross_entropy`) — the full
+    ``(batch, seq, vocab)`` logits tensor never exists, so large-batch /
+    large-vocab steps fit in HBM. Pass as ``make_train_step(loss_fn=...)``."""
+
+    def loss(params, apply_fn, batch, rng):
+        x, y = batch
+        hidden = apply_fn(
+            {"params": params}, x, deterministic=False,
+            rngs={"dropout": rng}, return_hidden=True,
+        )
+        w, transpose, bias = head_weight(params)
+        loss_val, n_valid = fused_linear_cross_entropy(
+            hidden, w, y, transpose_weight=transpose, bias=bias,
+            chunk=chunk, compute_dtype=jnp.dtype(compute_dtype),
+        )
+        return loss_val, {"n_valid": n_valid}
+
+    return loss
 
 
 def make_train_step(
