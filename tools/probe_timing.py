@@ -1,0 +1,73 @@
+"""Probe: is block_until_ready honest under the axon TPU tunnel?
+
+Times the same jitted train step three ways:
+  a) block_until_ready(loss) after N steps      (what bench.py r1 did)
+  b) float(loss) fetched after N steps          (forces device->host value)
+  c) float(loss) fetched after EVERY step       (serializes; upper bound)
+
+If (a) << (b), block_until_ready is lying on this platform and every r1
+number is dispatch time, not execution time.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from llm_in_practise_tpu.models.gpt import GPT, gptlike_config
+from llm_in_practise_tpu.train.step import make_train_step
+from llm_in_practise_tpu.parallel import strategy as S
+from llm_in_practise_tpu.core import mesh as mesh_lib
+
+VOCAB, SEQ, BATCH = 32768, 256, 128
+ITERS = 10
+
+cfg = gptlike_config(VOCAB, seq_len=SEQ, dropout=0.0, compute_dtype="bfloat16")
+model = GPT(cfg)
+strat = S.ddp(devices=1)
+mesh = strat.build_mesh()
+state = S.shard_init(model, strat, mesh, optax.adamw(3e-4),
+                     jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32))
+step = make_train_step()
+
+n_params = sum(x.size for x in jax.tree.leaves(state.params))
+print(f"params: {n_params/1e6:.1f}M  device: {jax.devices()[0].device_kind}")
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)), jnp.int32)
+batch = (x, jnp.roll(x, -1, axis=1))
+with mesh:
+    batch = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
+    # warmup / compile
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    print("warmup loss:", float(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt_a = (time.perf_counter() - t0) / ITERS
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    dt_b = (time.perf_counter() - t0) / ITERS
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+    dt_c = (time.perf_counter() - t0) / ITERS
+
+tok = BATCH * SEQ
+flop_step = 6 * n_params * tok + 12 * cfg.n_layer * SEQ * cfg.embed_dim * tok
+for name, dt in (("block_until_ready", dt_a), ("float-after", dt_b),
+                 ("float-every-step", dt_c)):
+    mfu = flop_step / dt / 197e12
+    print(f"{name:20s} {dt*1e3:9.2f} ms/step  {tok/dt:12.0f} tok/s  "
+          f"implied MFU {mfu*100:7.1f}%")
