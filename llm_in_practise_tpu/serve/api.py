@@ -71,7 +71,10 @@ class OpenAIServer:
         # request's ``model`` field (see serve/adapters.py).
         self.adapters = dict(adapters or {})
         self._httpd: ThreadingHTTPServer | None = None
-        self._embed_fn = None  # lazily jitted /v1/embeddings pooler
+        # lazily jitted /v1/embeddings pooler, keyed per engine: adapter
+        # engines may carry different modules, and a pooler closing over
+        # one engine's model must never run another's params
+        self._embed_fns: dict[int, object] = {}
 
     def engine_for(self, model: str | None) -> InferenceEngine | None:
         if model in (None, "", self.model_name):
@@ -109,7 +112,8 @@ class OpenAIServer:
                 "message": f"model {body.get('model')!r} not found",
                 "type": "invalid_request_error"}})
 
-        if self._embed_fn is None:
+        embed_fn = self._embed_fns.get(id(engine))
+        if embed_fn is None:
             model = engine.model
 
             def embed(params, ids, length):
@@ -119,7 +123,7 @@ class OpenAIServer:
                 pooled = (h * mask).sum(axis=1) / jnp.maximum(length, 1)
                 return pooled[0].astype(jnp.float32)
 
-            self._embed_fn = jax.jit(embed)
+            embed_fn = self._embed_fns[id(engine)] = jax.jit(embed)
 
         data, total = [], 0
         for i, item in enumerate(inputs):
@@ -131,7 +135,7 @@ class OpenAIServer:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(ids)] = ids
             try:
-                vec = np.asarray(self._embed_fn(
+                vec = np.asarray(embed_fn(
                     engine.params, jnp.asarray(padded),
                     jnp.asarray(len(ids), jnp.int32)), np.float64)
             except TypeError:

@@ -106,7 +106,11 @@ class ReplicaAutoscaler:
         return [u for u in self.router.upstreams if u.group == self.group]
 
     def ongoing(self) -> int:
-        return sum(u.pending for u in self.replicas())
+        # draining victims left the router but their in-flight requests are
+        # still load — excluding them would bias the mean downward during
+        # every drain and trigger cascading downscales
+        return (sum(u.pending for u in self.replicas())
+                + sum(u.pending for u in self._draining))
 
     # -- the control law ------------------------------------------------------
 
@@ -118,23 +122,26 @@ class ReplicaAutoscaler:
             return 0.0
         return sum(v for _, v in self._samples) / len(self._samples)
 
-    def _reap_drained(self) -> int:
-        """Stop draining replicas whose last in-flight request finished."""
-        reaped = 0
-        for u in list(self._draining):
-            if u.pending == 0:
-                self._draining.remove(u)
-                self.stop(u)
-                reaped += 1
-        self.downscales += reaped
-        return reaped
-
     def tick(self, now: float | None = None) -> int:
-        """One control step; returns the replica delta applied (+/-/0)."""
+        """One control step; returns the replica delta applied (+/-/0).
+
+        Decisions are taken under the state lock; the user-supplied
+        ``spawn``/``stop`` callbacks run **outside** it — a slow spawn must
+        not block metric sampling, and a callback that re-enters scaler
+        methods (``ongoing()``, even ``tick()``) must not deadlock. One
+        controller per group: concurrent ``tick`` calls would race the
+        spawn/stop decisions themselves.
+        """
         cfg = self.config
         now = self.clock() if now is None else now
+        to_stop: list[Upstream] = []
+        n_spawn = 0
         with self._lock:
-            reaped = self._reap_drained()
+            # reap: draining replicas whose last in-flight request finished
+            for u in list(self._draining):
+                if u.pending == 0:
+                    self._draining.remove(u)
+                    to_stop.append(u)
             self._samples.append((now, float(self.ongoing())))
             current = len(self.replicas())
             desired = math.ceil(
@@ -145,55 +152,54 @@ class ReplicaAutoscaler:
                 self._want_down_since = None
                 if self._want_up_since is None:
                     self._want_up_since = now
-                if now - self._want_up_since < cfg.upscale_delay_s:
-                    return -reaped
-                self._want_up_since = None
-                fresh: list[Upstream] = []
-                try:
-                    for _ in range(desired - current):
-                        fresh.append(self.spawn())
-                finally:
-                    # register even a partial batch (a failed later spawn
-                    # must not leak the replicas already brought up);
-                    # atomic list swap: request threads iterate
-                    # router.upstreams without a lock — never mutate the
-                    # live list in place
-                    if fresh:
-                        with self._router_lock:
-                            self.router.upstreams = (
-                                self.router.upstreams + fresh)
-                        self.upscales += len(fresh)
-                return len(fresh) - reaped
-
-            if desired < current:
+                if now - self._want_up_since >= cfg.upscale_delay_s:
+                    self._want_up_since = None
+                    n_spawn = desired - current
+            elif desired < current:
                 self._want_up_since = None
                 if self._want_down_since is None:
                     self._want_down_since = now
-                if now - self._want_down_since < cfg.downscale_delay_s:
-                    return -reaped
+                if now - self._want_down_since >= cfg.downscale_delay_s:
+                    self._want_down_since = None
+                    # drain the idlest replicas: out of the router now (no
+                    # new picks), stopped only once in-flight hits zero — a
+                    # request that raced the selection finishes before
+                    # teardown; reaped no earlier than the NEXT tick, so a
+                    # request thread that picked the victim just before the
+                    # swap gets one metrics interval to bump pending
+                    victims = sorted(
+                        (u for u in self.replicas() if u.pending == 0),
+                        key=lambda u: u.served,
+                    )[: current - desired]
+                    if victims:
+                        gone = set(map(id, victims))
+                        with self._router_lock:  # atomic list swap
+                            self.router.upstreams = [
+                                u for u in self.router.upstreams
+                                if id(u) not in gone]
+                        self._draining.extend(victims)
+            else:
+                self._want_up_since = None
                 self._want_down_since = None
-                # drain the idlest replicas: out of the router now (no new
-                # picks), stopped only once in-flight hits zero — a request
-                # that raced the selection finishes before teardown
-                victims = sorted(
-                    (u for u in self.replicas() if u.pending == 0),
-                    key=lambda u: u.served,
-                )[: current - desired]
-                if victims:
-                    gone = set(map(id, victims))
-                    with self._router_lock:  # atomic swap (see upscale)
-                        self.router.upstreams = [
-                            u for u in self.router.upstreams
-                            if id(u) not in gone]
-                    self._draining.extend(victims)
-                # newly drained victims are reaped no earlier than the NEXT
-                # tick: a request thread that picked the victim just before
-                # the swap gets one metrics interval to bump pending
-                return -reaped
 
-            self._want_up_since = None
-            self._want_down_since = None
-            return -reaped
+        # -- callbacks, outside the lock --
+        for u in to_stop:
+            self.stop(u)
+        self.downscales += len(to_stop)
+        fresh: list[Upstream] = []
+        if n_spawn:
+            try:
+                for _ in range(n_spawn):
+                    fresh.append(self.spawn())
+            finally:
+                # register even a partial batch (a failed later spawn must
+                # not leak the replicas already brought up); atomic list
+                # swap: request threads iterate router.upstreams lock-free
+                if fresh:
+                    with self._router_lock:
+                        self.router.upstreams = self.router.upstreams + fresh
+                    self.upscales += len(fresh)
+        return len(fresh) - len(to_stop)
 
     # -- background controller ------------------------------------------------
 

@@ -163,6 +163,13 @@ class HostKVPool(PrefixLRU):
 # --- L3: remote pool server (the ``lm://`` analog) --------------------------
 
 
+# Framing caps: the wire header declares 32-bit lengths, so an untrusted
+# peer could demand ~4 GiB allocations per message. Cap both fields before
+# allocating — a violation desyncs the stream, so the connection is closed.
+MAX_HEADER_BYTES = 1 << 20          # JSON manifest: token keys only
+MAX_PAYLOAD_BYTES = 1 << 30         # one serialized prefix entry
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -178,8 +185,17 @@ def _send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
     sock.sendall(struct.pack("<II", len(head), len(payload)) + head + payload)
 
 
-def _recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
+def _recv_msg(
+    sock: socket.socket, *,
+    max_header: int = MAX_HEADER_BYTES,
+    max_payload: int = MAX_PAYLOAD_BYTES,
+) -> tuple[dict, bytes]:
     hlen, plen = struct.unpack("<II", _recv_exact(sock, 8))
+    if hlen > max_header or plen > max_payload:
+        raise ConnectionError(
+            f"kv pool message exceeds caps (header {hlen} > {max_header} or "
+            f"payload {plen} > {max_payload}) — closing connection"
+        )
     header = json.loads(_recv_exact(sock, hlen).decode())
     payload = _recv_exact(sock, plen) if plen else b""
     return header, payload
@@ -194,25 +210,60 @@ class KVPoolServer:
     them, so a base model and its LoRA adapters, or two different served
     models, must never cross-hit (LMCache namespaces the same way).
     ``get`` performs the longest-strict-prefix match server-side so
-    clients need one round-trip. LRU by tokens, budgeted per namespace."""
+    clients need one round-trip.
+
+    Budgets are **global**, not per-namespace: one LRU spans every
+    namespace (the namespace rides as the first key element, so prefix
+    matching stays exact and namespaces can never cross-hit), bounded by
+    ``max_tokens`` AND ``max_bytes`` (blob sizes are known at put time —
+    size ``max_bytes`` to the pod's memory). The namespace set itself is
+    bounded (``max_namespaces``): a peer inventing namespaces is refused
+    rather than allocating, and lookups against unknown namespaces only
+    count a miss.
+
+    Trust boundary: the wire protocol is unauthenticated — bind to
+    loopback (the default) or an in-cluster ClusterIP service reachable
+    only by the serving pods; framing caps (:func:`_recv_msg`) bound the
+    per-message allocation an untrusted peer can demand."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
-                 max_tokens: int = 1 << 22, min_prefix: int = 16):
+                 max_tokens: int = 1 << 22, min_prefix: int = 16,
+                 max_bytes: int = 4 << 30, max_namespaces: int = 64,
+                 max_payload: int = MAX_PAYLOAD_BYTES):
         self.min_prefix = min_prefix
         self.max_tokens = max_tokens
-        # one store per namespace; values are (length, bucket, blob)
-        self._stores: dict[str, PrefixLRU] = {}
-        self._stores_lock = threading.Lock()
-        self._unknown_ns_misses = 0   # gets for namespaces with no store
+        self.max_bytes = max_bytes
+        self.max_namespaces = max_namespaces
+        self.max_payload = min(max_payload, max_bytes)
+        self.rejected = 0             # puts refused (ns budget / size caps)
+        self._unknown_ns_misses = 0   # gets for namespaces never put to
+        self._namespaces: set[str] = set()
+        # live entries per namespace: a namespace whose last entry is
+        # evicted releases its slot (rolling model redeploys would
+        # otherwise exhaust max_namespaces forever)
+        self._ns_counts: dict[str, int] = {}
+        self._total_bytes = 0
+        # RLock: _put holds it across peek/account/store.put so concurrent
+        # puts of the same key cannot double-count, and the store's
+        # on_evict (which re-enters for the byte decrement) fires on the
+        # same thread inside that region
+        self._acct_lock = threading.RLock()
+        # One global store. Keys are (ns, tok0, tok1, ...); values are
+        # (key_len, bucket, blob, token_length) where key_len counts the
+        # ns element, so PrefixLRU's length/prefix logic applies unchanged.
+        self._store = PrefixLRU(
+            max_tokens=max_tokens, min_prefix=min_prefix + 1,
+            length_of=lambda v: v[0], on_evict=self._on_evict)
         pool = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
                     while True:
-                        header, payload = _recv_msg(self.request)
+                        header, payload = _recv_msg(
+                            self.request, max_payload=pool.max_payload)
                         pool._dispatch(self.request, header, payload)
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, ValueError, KeyError):
                     return
 
         class Server(socketserver.ThreadingTCPServer):
@@ -234,22 +285,25 @@ class KVPoolServer:
 
     # -- ops ----------------------------------------------------------------
 
-    def _store_for(self, ns: str) -> PrefixLRU:
-        with self._stores_lock:
-            store = self._stores.get(ns)
-            if store is None:
-                store = self._stores[ns] = PrefixLRU(
-                    max_tokens=self.max_tokens, min_prefix=self.min_prefix,
-                    length_of=lambda v: v[0])
-            return store
+    def _on_evict(self, key, value) -> None:
+        with self._acct_lock:
+            self._total_bytes -= len(value[2])
+            ns = key[0]
+            n = self._ns_counts.get(ns, 0) - 1
+            if n <= 0:
+                self._ns_counts.pop(ns, None)
+                self._namespaces.discard(ns)   # slot freed for reuse
+            else:
+                self._ns_counts[ns] = n
 
     def _dispatch(self, sock, header: dict, payload: bytes) -> None:
         op = header.get("op")
         ns = str(header.get("ns", ""))
         if op == "put":
-            self._put(ns, tuple(header["key"]), int(header["length"]),
-                      int(header["bucket"]), payload)
-            _send_msg(sock, {"ok": True})
+            ok, why = self._put(ns, tuple(header["key"]),
+                                int(header["length"]),
+                                int(header["bucket"]), payload)
+            _send_msg(sock, {"ok": ok} if ok else {"ok": False, "error": why})
         elif op == "get":
             found = self._get(ns, tuple(header["prompt"]))
             if found is None:
@@ -259,51 +313,84 @@ class KVPoolServer:
                 _send_msg(sock, {"found": True, "length": length,
                                  "bucket": bucket}, blob)
         elif op == "stats":
-            with self._stores_lock:
-                stores = list(self._stores.values())
+            with self._acct_lock:
+                total_bytes = self._total_bytes
+                n_ns = len(self._namespaces)
             _send_msg(sock, {
-                "entries": sum(s.n_entries for s in stores),
-                "cached_tokens": sum(s.cached_tokens for s in stores),
+                "entries": self._store.n_entries,
+                # ns key element is bookkeeping, not a cached token
+                "cached_tokens":
+                    self._store.cached_tokens - self._store.n_entries,
+                "cached_bytes": total_bytes,
                 "hits": self.hits, "misses": self.misses,
-                "namespaces": len(stores),
+                "namespaces": n_ns, "rejected": self.rejected,
             })
         else:
             _send_msg(sock, {"ok": False, "error": f"unknown op {op!r}"})
 
     @property
     def hits(self) -> int:
-        with self._stores_lock:
-            return sum(s.hits for s in self._stores.values())
+        return self._store.hits
 
     @property
     def misses(self) -> int:
-        with self._stores_lock:
-            return (self._unknown_ns_misses
-                    + sum(s.misses for s in self._stores.values()))
+        return self._unknown_ns_misses + self._store.misses
+
+    @property
+    def cached_bytes(self) -> int:
+        with self._acct_lock:
+            return self._total_bytes
 
     @property
     def _entries(self):
-        """Aggregated view (tests/introspection only)."""
-        merged = {}
-        with self._stores_lock:
-            for ns, store in self._stores.items():
-                for key, value in store._entries.items():
-                    merged[(ns, key)] = value
-        return merged
+        """Aggregated view (tests/introspection only): {(ns, key): value}."""
+        with self._store._lock:
+            return {(k[0], k[1:]): v for k, v in self._store._entries.items()}
 
     def _put(self, ns: str, key: tuple, length: int, bucket: int,
-             blob: bytes) -> None:
-        self._store_for(ns).put(list(key), (length, bucket, blob))
+             blob: bytes) -> tuple[bool, str]:
+        # validate BEFORE consuming any budget: a rejected or silently
+        # dropped put must neither burn a namespace slot nor leak bytes
+        # into the accounting (PrefixLRU.put drops sub-min_prefix entries)
+        if length < self.min_prefix:
+            self.rejected += 1
+            return False, f"prefix shorter than min_prefix={self.min_prefix}"
+        if len(blob) > self.max_payload:
+            self.rejected += 1
+            return False, "entry larger than max_payload"
+        full_key = (ns,) + tuple(key[:length])
+        with self._acct_lock:
+            if ns not in self._namespaces:
+                if len(self._namespaces) >= self.max_namespaces:
+                    self.rejected += 1
+                    return False, "namespace budget exhausted"
+                self._namespaces.add(ns)
+            old = self._store.peek(full_key)
+            if old is not None:
+                self._total_bytes -= len(old[2])
+            else:
+                self._ns_counts[ns] = self._ns_counts.get(ns, 0) + 1
+            self._total_bytes += len(blob)
+            self._store.put(full_key, (length + 1, bucket, blob))
+            # byte budget: evict globally-LRU entries (any namespace);
+            # pop_lru -> on_evict re-enters the RLock for the decrement
+            while self._total_bytes > self.max_bytes:
+                if self._store.pop_lru() is None:
+                    break
+        return True, ""
 
     def _get(self, ns: str, prompt: tuple):
-        # ns is client-controlled: never allocate a store on lookup, or
-        # probing with varied namespaces grows the server without bound
-        with self._stores_lock:
-            store = self._stores.get(ns)
-            if store is None:
-                self._unknown_ns_misses += 1   # cold-start misses count too
-                return None
-        return store.lookup(prompt)
+        with self._acct_lock:
+            known = ns in self._namespaces
+        if not known:
+            # ns is client-controlled: unknown namespaces only count a miss
+            self._unknown_ns_misses += 1
+            return None
+        found = self._store.lookup((ns,) + prompt)
+        if found is None:
+            return None
+        key_len, bucket, blob = found
+        return key_len - 1, bucket, blob
 
 
 class RemoteKVClient:
@@ -321,8 +408,12 @@ class RemoteKVClient:
         self.timeout = timeout
         self.namespace = namespace
 
-    def _call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
-        with socket.create_connection(self.address, timeout=self.timeout) as s:
+    def _call(self, header: dict, payload: bytes = b"",
+              timeout: float | None = None) -> tuple[dict, bytes]:
+        with socket.create_connection(
+            self.address, timeout=timeout if timeout is not None
+            else self.timeout
+        ) as s:
             _send_msg(s, header, payload)
             return _recv_msg(s)
 
@@ -332,9 +423,11 @@ class RemoteKVClient:
                     "length": host.length, "bucket": host.bucket},
                    encode_entry(host))
 
-    def get(self, prompt_ids) -> HostEntry | None:
+    def get(self, prompt_ids,
+            timeout: float | None = None) -> HostEntry | None:
         header, payload = self._call(
-            {"op": "get", "ns": self.namespace, "prompt": list(prompt_ids)})
+            {"op": "get", "ns": self.namespace, "prompt": list(prompt_ids)},
+            timeout=timeout)
         if not header.get("found"):
             return None
         return decode_entry(payload)
@@ -372,7 +465,7 @@ class TieredKV:
                  remote: RemoteKVClient | None = None, *,
                  offload_on_put: bool = True, async_offload: bool = True,
                  queue_size: int = 64, remote_cooldown_s: float = 30.0,
-                 clock=None):
+                 lookup_timeout_s: float = 0.75, clock=None):
         self.host_pool = host_pool if host_pool is not None else HostKVPool()
         self.remote = remote
         self.offload_on_put = offload_on_put
@@ -382,6 +475,11 @@ class TieredKV:
         # dead pool server must not cost a connect timeout per admission —
         # after one failure the remote sits out remote_cooldown_s
         self.remote_cooldown_s = remote_cooldown_s
+        # lookups get their own (short) deadline — the client's default
+        # timeout is sized for puts of large blobs, and a slow-but-alive
+        # pool server must not stall decode for every active slot
+        self.lookup_timeout_s = lookup_timeout_s
+        self.slow_trips = 0
         self._remote_down_until = 0.0
         self._clock = clock or __import__("time").monotonic
         self._queue: "queue.Queue | None" = (
@@ -453,11 +551,20 @@ class TieredKV:
         into the host pool, so unusable prefixes cost no transfers."""
         host = self.host_pool.lookup(prompt_ids, usable=usable)
         if host is None and self._remote_ok():
+            t0 = self._clock()
             try:
-                host = self.remote.get(prompt_ids)
+                host = self.remote.get(prompt_ids,
+                                       timeout=self.lookup_timeout_s)
             except OSError:
                 self._remote_failed()
                 host = None
+            else:
+                # slow-but-responsive server: keep the result but trip the
+                # cooldown so the next misses don't pay the same stall
+                if self._clock() - t0 > self.lookup_timeout_s:
+                    self.slow_trips += 1
+                    self._remote_down_until = (
+                        self._clock() + self.remote_cooldown_s)
             if host is not None and usable is not None and not usable(host):
                 host = None
             if host is not None:
@@ -473,15 +580,24 @@ def main() -> None:
     import argparse
 
     p = argparse.ArgumentParser(description=main.__doc__)
-    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address; the protocol is unauthenticated — "
+                        "use 0.0.0.0 only behind an in-cluster ClusterIP "
+                        "reachable solely by the serving pods")
     p.add_argument("--port", type=int, default=8100)
     p.add_argument("--max-tokens", type=int, default=1 << 22,
-                   help="pool budget in cached prefix tokens")
+                   help="global pool budget in cached prefix tokens")
+    p.add_argument("--max-bytes", type=int, default=4 << 30,
+                   help="global pool budget in blob bytes — size this to "
+                        "the pod's memory limit minus headroom")
+    p.add_argument("--max-namespaces", type=int, default=64)
     args = p.parse_args()
-    server = KVPoolServer(args.host, args.port, max_tokens=args.max_tokens)
+    server = KVPoolServer(args.host, args.port, max_tokens=args.max_tokens,
+                          max_bytes=args.max_bytes,
+                          max_namespaces=args.max_namespaces)
     server.start()
     print(f"kv pool server on {server.address[0]}:{server.address[1]} "
-          f"(budget {args.max_tokens} tokens)")
+          f"(budget {args.max_tokens} tokens / {args.max_bytes} bytes)")
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

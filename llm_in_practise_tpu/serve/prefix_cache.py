@@ -123,6 +123,25 @@ class PrefixLRU:
             for ekey, evalue in evicted:
                 self.on_evict(ekey, evalue)
 
+    def peek(self, key) -> object | None:
+        """Exact-key read without touching LRU order (accounting hooks)."""
+        with self._lock:
+            return self._entries.get(tuple(key))
+
+    def pop_lru(self):
+        """Evict and return the least-recently-used (key, value), or None.
+
+        Unlike :meth:`put`'s budget loop this will empty the store —
+        callers enforcing an external budget (bytes) own the floor."""
+        with self._lock:
+            if not self._entries:
+                return None
+            key, value = self._entries.popitem(last=False)
+            self._total_tokens -= self._length_of(value)
+        if self.on_evict is not None:
+            self.on_evict(key, value)
+        return key, value
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

@@ -302,3 +302,180 @@ def test_caller_on_evict_hook_is_chained(rng):
     engine.generate(PROMPT_B, sp)            # evicts A from the tiny L1
     assert seen and seen[0][: len(PROMPT_A)] == tuple(PROMPT_A)
     assert pool.host_pool.cached_tokens >= 32  # offload also ran
+
+
+# --- hardening: framing caps, global budgets, namespace bound ---------------
+
+
+def test_pool_server_rejects_oversized_frames():
+    import socket
+    import struct
+
+    server = KVPoolServer(max_payload=1 << 16).start()
+    try:
+        # a header declaring a ~4 GiB payload must be refused without
+        # allocation — the server closes the connection
+        with socket.create_connection(server.address, timeout=2.0) as s:
+            s.sendall(struct.pack("<II", 8, (1 << 32) - 1) + b'{"op":1}')
+            assert s.recv(1) == b""  # closed, nothing served
+        # and the server is still healthy for well-formed clients
+        client = RemoteKVClient(server.address, namespace="m")
+        entry = _host_entry(length=16, bucket=16)
+        client.put(list(range(16)), entry)
+        assert client.get(list(range(20))) is not None
+    finally:
+        server.stop()
+
+
+def test_pool_server_global_byte_budget_evicts_lru():
+    entry = _host_entry(length=16, bucket=16)
+    blob_size = len(encode_entry(entry))
+    server = KVPoolServer(max_bytes=int(blob_size * 2.5),
+                          max_tokens=1 << 20).start()
+    try:
+        a = RemoteKVClient(server.address, namespace="a")
+        b = RemoteKVClient(server.address, namespace="b")
+        p1, p2, p3 = ([i, *range(1, 16)] for i in (101, 102, 103))
+        a.put(p1, entry)
+        b.put(p2, entry)   # budget spans namespaces: 2 entries fit
+        assert server.cached_bytes == 2 * blob_size
+        a.put(p3, entry)   # third exceeds the byte budget → LRU (p1) out
+        assert server.cached_bytes == 2 * blob_size
+        assert a.get(p1 + [99]) is None
+        assert b.get(p2 + [99]) is not None
+        assert a.get(p3 + [99]) is not None
+    finally:
+        server.stop()
+
+
+def test_pool_server_bounds_namespaces():
+    server = KVPoolServer(max_namespaces=2).start()
+    try:
+        entry = _host_entry(length=16, bucket=16)
+        for ns in ("a", "b"):
+            RemoteKVClient(server.address, namespace=ns).put(
+                list(range(16)), entry)
+        # a third namespace is refused, not allocated
+        RemoteKVClient(server.address, namespace="c").put(
+            list(range(16)), entry)
+        assert server.rejected == 1
+        assert RemoteKVClient(server.address, namespace="c").get(
+            list(range(20))) is None
+        # existing namespaces still work (and replacement puts too)
+        assert RemoteKVClient(server.address, namespace="a").get(
+            list(range(20))) is not None
+    finally:
+        server.stop()
+
+
+def test_slow_remote_lookup_trips_cooldown():
+    """A slow-but-alive pool server must not stall decode on every miss."""
+    server = KVPoolServer().start()
+    try:
+        client = RemoteKVClient(server.address, namespace="m", timeout=5.0)
+        entry = _host_entry(length=16, bucket=16)
+        client.put(list(range(16)), entry)
+        clock = {"t": 0.0}
+        pool = TieredKV(
+            HostKVPool(min_prefix=4), client,
+            remote_cooldown_s=30.0, lookup_timeout_s=0.25,
+            clock=lambda: clock["t"],
+        )
+        # make the wall-clock measurement read "slow" by advancing the
+        # injected clock inside the remote call
+        real_get = client.get
+
+        def slow_get(prompt_ids, timeout=None):
+            clock["t"] += 1.0  # pretend the round-trip took 1 s
+            return real_get(prompt_ids, timeout=timeout)
+
+        client.get = slow_get
+        hit = pool.lookup(list(range(16)))
+        assert hit is not None          # result kept
+        assert pool.slow_trips == 1     # but the breaker tripped
+        assert pool.remote_errors == 0  # and it is not counted as an error
+        # within the cooldown the remote is skipped
+        pool.host_pool.clear()
+        assert pool.lookup(list(range(16))) is None
+        assert pool.slow_trips == 1
+    finally:
+        server.stop()
+
+
+def test_pool_server_short_prefix_put_does_not_leak_budget():
+    """Entries below min_prefix are refused up front — they must not
+    inflate cached_bytes (which would eventually evict the whole store)."""
+    server = KVPoolServer(min_prefix=16).start()
+    try:
+        client = RemoteKVClient(server.address, namespace="m")
+        short = _host_entry(length=8, bucket=8)   # 8 < min_prefix
+        client.put(list(range(8)), short)
+        assert server.cached_bytes == 0
+        assert server.rejected == 1
+        # a rejected put must not burn a namespace slot either
+        assert "m" not in server._namespaces
+        # oversized blob: the framing cap refuses it at the wire (the
+        # connection closes before _put runs) — no budget consumed
+        big_server = KVPoolServer(min_prefix=4, max_payload=16).start()
+        try:
+            c2 = RemoteKVClient(big_server.address, namespace="n")
+            try:
+                c2.put(list(range(16)), _host_entry(length=16, bucket=16))
+            except (ConnectionError, OSError):
+                pass  # server closed the over-cap connection
+            assert big_server.cached_bytes == 0
+            assert "n" not in big_server._namespaces
+        finally:
+            big_server.stop()
+    finally:
+        server.stop()
+
+
+def test_pool_server_replacement_put_accounts_once():
+    entry = _host_entry(length=16, bucket=16)
+    blob = len(encode_entry(entry))
+    server = KVPoolServer().start()
+    try:
+        client = RemoteKVClient(server.address, namespace="m")
+        client.put(list(range(16)), entry)
+        client.put(list(range(16)), entry)   # same key: replace, not add
+        assert server.cached_bytes == blob
+    finally:
+        server.stop()
+
+
+def test_gateway_metrics_with_remote_cache():
+    """/metrics must render when the gateway holds a RemoteResponseCache."""
+    from llm_in_practise_tpu.serve.cache_service import RemoteResponseCache
+    from llm_in_practise_tpu.serve.gateway import Gateway, Router, Upstream
+
+    gw = Gateway(Router([Upstream("http://127.0.0.1:9", model="m",
+                                  group="g")]),
+                 cache=RemoteResponseCache("http://127.0.0.1:9",
+                                           timeout_s=0.1))
+    text = gw.metrics_text()
+    assert "gateway_cache_hits_total 0" in text
+    assert "gateway_cache_misses_total 0" in text
+
+
+def test_namespace_slot_released_when_entries_evicted():
+    """Rolling redeploys mint new namespace strings; a namespace whose
+    entries are all evicted must release its slot or the budget would be
+    exhausted forever."""
+    entry = _host_entry(length=16, bucket=16)
+    blob = len(encode_entry(entry))
+    # byte budget fits exactly one entry at a time
+    server = KVPoolServer(max_namespaces=2,
+                          max_bytes=int(blob * 1.5)).start()
+    try:
+        for i, ns in enumerate(("v1", "v2", "v3", "v4")):
+            c = RemoteKVClient(server.address, namespace=ns)
+            prompt = [100 + i, *range(1, 16)]
+            c.put(prompt, entry)
+            # each put evicts the previous namespace's only entry,
+            # releasing its slot — so v3 and v4 are NOT refused
+            assert c.get(prompt + [99]) is not None, ns
+        assert server.rejected == 0
+        assert len(server._namespaces) == 1
+    finally:
+        server.stop()
