@@ -42,7 +42,14 @@ def main():
     p.add_argument("--cache_ttl", type=float, default=300.0)
     p.add_argument("--semantic_threshold", type=float, default=0.97,
                    help="cosine threshold for the semantic cache; <=0 disables")
-    p.add_argument("--no_cache", action="store_true")
+    p.add_argument("--no_cache", action="store_true",
+                   help="disable response caching entirely (wins over "
+                        "--cache_url)")
+    p.add_argument("--cache_url", "--cache-url", default=None,
+                   help="base URL of a shared cache service "
+                        "(serve.cache_service; deploy/k8s/09-semantic-cache) "
+                        "— replaces the in-process cache so every gateway "
+                        "replica shares one store")
     p.add_argument("--moderation", action="store_true",
                    help="enable the pre-call guard hook")
     p.add_argument("--routing", default="least_pending",
@@ -77,7 +84,13 @@ def main():
         fallbacks.setdefault(group, []).append(fb)
 
     cache = None
-    if not args.no_cache:
+    if args.no_cache:
+        pass  # explicit opt-out wins over any --cache_url
+    elif args.cache_url:
+        from llm_in_practise_tpu.serve.cache_service import RemoteResponseCache
+
+        cache = RemoteResponseCache(args.cache_url)
+    else:
         thr = args.semantic_threshold if args.semantic_threshold > 0 else None
         cache = ResponseCache(ttl_s=args.cache_ttl, semantic_threshold=thr)
 

@@ -1,0 +1,262 @@
+"""Standalone exact+semantic response cache service — the deployable L2/L3
+cache stage.
+
+The reference builds this as its own platform stage: a cache gateway with a
+Redis/Valkey exact tier, a semantic tier keyed by embeddings from a separate
+embedding service, and K8s manifests wiring multiple LiteLLM replicas to the
+shared store (``LLM_on_Kubernetes/Inference_Platfrom/README.md:2845-3488``).
+In-process caching inside each gateway replica (``gateway.ResponseCache``)
+cannot give that: two replicas answering the same question still compute it
+twice.
+
+This module is that stage, stdlib-only:
+
+- :class:`CacheService` — an HTTP service holding ONE
+  :class:`~.gateway.ResponseCache` shared by every gateway replica.
+  ``POST /cache/get`` (the chat request body) → ``{"found": bool,
+  "response": ...}``; ``POST /cache/put`` (``{"request", "response"}``);
+  ``GET /metrics`` (Prometheus text), ``GET /health``. Optionally takes
+  ``embed_url`` pointing at a ``/v1/embeddings`` endpoint (the model
+  server's — :mod:`.api` serves it) so the semantic tier matches on real
+  model embeddings instead of hashed bag-of-words, exactly the reference's
+  cache→embedding-service call graph.
+
+- :class:`RemoteResponseCache` — the client a gateway replica holds in
+  place of its in-process cache (duck-typed ``get``/``put``). Fail-open:
+  a dead or slow cache service degrades to a miss (with a cooldown so the
+  serving path doesn't pay a connect timeout per request), never an error.
+
+Deployment: ``deploy/k8s/09-semantic-cache/`` runs this as a Deployment +
+ClusterIP Service and points the gateway replicas at it (``--cache-url``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from llm_in_practise_tpu.serve.gateway import ResponseCache
+
+
+def embeddings_client(embed_url: str, *, timeout_s: float = 10.0,
+                      model: str = ""):
+    """``embed_fn(text) -> list[float]`` backed by a ``/v1/embeddings``
+    endpoint; raises on transport errors (the caller decides the fallback)."""
+
+    def embed(text: str) -> list[float]:
+        req = urllib.request.Request(
+            embed_url.rstrip("/") + "/v1/embeddings",
+            data=json.dumps({"input": text, "model": model}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            payload = json.loads(r.read())
+        return payload["data"][0]["embedding"]
+
+    return embed
+
+
+class CacheService:
+    """One shared cache, HTTP-fronted. See module docstring."""
+
+    def __init__(self, *, ttl_s: float = 300.0, max_entries: int = 4096,
+                 semantic_threshold: float | None = 0.97,
+                 embed_url: str | None = None):
+        embed_fn = None
+        if embed_url:
+            remote = embeddings_client(embed_url)
+            fallback_failures = {"n": 0}
+
+            def embed_fn(text: str) -> list[float]:
+                # embedding-service outage must not take the cache down:
+                # fall back to the hashed-BoW embedding (entries made under
+                # different encoders won't cross-match above threshold —
+                # self-consistent within each encoder's entries)
+                from llm_in_practise_tpu.serve.gateway import _token_embed
+                try:
+                    return remote(text)
+                except (urllib.error.URLError, TimeoutError, OSError,
+                        KeyError, json.JSONDecodeError):
+                    fallback_failures["n"] += 1
+                    return _token_embed(text)
+
+            self._embed_failures = fallback_failures
+        else:
+            self._embed_failures = {"n": 0}
+        self.cache = ResponseCache(
+            ttl_s=ttl_s, max_entries=max_entries,
+            semantic_threshold=semantic_threshold, embed_fn=embed_fn)
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # -- request handling -----------------------------------------------------
+
+    def handle(self, method: str, path: str, body: dict | None):
+        """(status, response-dict). Transport-agnostic for tests."""
+        if method == "GET" and path == "/health":
+            return 200, {"status": "ok"}
+        if method == "GET" and path == "/metrics":
+            return 200, {"text": self.metrics_text()}
+        if method == "POST" and path == "/cache/get":
+            if not isinstance(body, dict):
+                return 422, {"error": "body must be the chat request"}
+            hit = self.cache.get(body)
+            return 200, ({"found": True, "response": hit}
+                         if hit is not None else {"found": False})
+        if method == "POST" and path == "/cache/put":
+            if (not isinstance(body, dict)
+                    or not isinstance(body.get("request"), dict)
+                    or "response" not in body):
+                return 422, {"error": "body must be {request, response}"}
+            self.cache.put(body["request"], body["response"])
+            return 200, {"ok": True}
+        return 404, {"error": f"no route {method} {path}"}
+
+    def metrics_text(self) -> str:
+        c = self.cache
+        lines = [
+            ("llm_cache_exact_hits_total", c.hits),
+            ("llm_cache_semantic_hits_total", c.semantic_hits),
+            ("llm_cache_misses_total", c.misses),
+            ("llm_cache_entries", len(c._exact)),
+            ("llm_cache_semantic_entries", len(c._semantic)),
+            ("llm_cache_embed_fallbacks_total", self._embed_failures["n"]),
+        ]
+        return "".join(f"{k} {v}\n" for k, v in lines)
+
+    # -- HTTP plumbing --------------------------------------------------------
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8200,
+              *, background: bool = False):
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, status: int, payload: dict):
+                if "text" in payload and len(payload) == 1:
+                    data = payload["text"].encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._reply(*service.handle("GET", self.path, None))
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n)) if n else None
+                except (ValueError, json.JSONDecodeError):
+                    return self._reply(422, {"error": "invalid JSON"})
+                self._reply(*service.handle("POST", self.path, body))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        bound = self._httpd.server_address
+        if background:
+            threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True).start()
+        else:
+            print(f"cache service on {bound[0]}:{bound[1]}")
+            self._httpd.serve_forever()
+        return bound
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+class RemoteResponseCache:
+    """Gateway-side client for a shared :class:`CacheService`.
+
+    Duck-types ``gateway.ResponseCache``'s ``get``/``put`` so
+    ``Gateway(cache=RemoteResponseCache(url))`` is a drop-in swap. Fail-open
+    with a cooldown: an unreachable cache service costs one failed call,
+    then sits out ``cooldown_s`` — the serving path never blocks on a dead
+    cache longer than ``timeout_s`` once per cooldown window.
+    """
+
+    def __init__(self, base_url: str, *, timeout_s: float = 2.0,
+                 cooldown_s: float = 30.0, clock=time.monotonic):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.cooldown_s = cooldown_s
+        self.errors = 0
+        # local counters mirroring ResponseCache's surface — the gateway's
+        # /metrics reads cache.hits/semantic_hits/misses whenever a cache
+        # is configured (gateway.metrics_text). The service does not say
+        # whether a hit was exact or semantic, so hits counts both here
+        # and semantic_hits stays 0; the split lives in the service's own
+        # /metrics.
+        self.hits = 0
+        self.semantic_hits = 0
+        self.misses = 0
+        self._down_until = 0.0
+        self._clock = clock
+
+    def _post(self, path: str, payload: dict) -> dict | None:
+        if self._clock() < self._down_until:
+            return None
+        req = urllib.request.Request(
+            self.base_url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, TimeoutError, OSError,
+                json.JSONDecodeError):
+            self.errors += 1
+            self._down_until = self._clock() + self.cooldown_s
+            return None
+
+    def get(self, body: dict) -> dict | None:
+        if body.get("stream"):
+            return None
+        reply = self._post("/cache/get", body)
+        if reply and reply.get("found"):
+            self.hits += 1
+            return reply["response"]
+        self.misses += 1
+        return None
+
+    def put(self, body: dict, response: dict) -> None:
+        if body.get("stream"):
+            return
+        self._post("/cache/put", {"request": body, "response": response})
+
+
+def main() -> None:
+    """Run the shared cache service (``deploy/k8s/09-semantic-cache/``)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--ttl", type=float, default=300.0)
+    p.add_argument("--max-entries", type=int, default=4096)
+    p.add_argument("--semantic-threshold", type=float, default=0.97,
+                   help="<=0 disables the semantic tier")
+    p.add_argument("--embed-url", default=None,
+                   help="base URL of a /v1/embeddings service for real "
+                        "semantic matching (default: hashed bag-of-words)")
+    args = p.parse_args()
+    thr = args.semantic_threshold if args.semantic_threshold > 0 else None
+    CacheService(ttl_s=args.ttl, max_entries=args.max_entries,
+                 semantic_threshold=thr, embed_url=args.embed_url,
+                 ).serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

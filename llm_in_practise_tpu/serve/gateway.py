@@ -242,10 +242,14 @@ class ResponseCache:
     Semantic: cosine over hashed-BoW embeddings of the last user message."""
 
     def __init__(self, *, ttl_s: float = 300.0, max_entries: int = 1024,
-                 semantic_threshold: float | None = 0.97):
+                 semantic_threshold: float | None = 0.97,
+                 embed_fn=None):
         self.ttl_s = ttl_s
         self.max_entries = max_entries
         self.semantic_threshold = semantic_threshold
+        # pluggable encoder: the standalone cache service swaps in a real
+        # /v1/embeddings call here (the reference's embedding service)
+        self._embed = embed_fn or _token_embed
         self._exact: dict[str, tuple[float, dict]] = {}
         self._semantic: list[tuple[float, str, list[float], dict]] = []
         self._lock = threading.Lock()
@@ -282,7 +286,7 @@ class ResponseCache:
                 self.hits += 1
                 return hit[1]
             if self.semantic_threshold is not None:
-                query = _token_embed(self._conversation_text(body))
+                query = self._embed(self._conversation_text(body))
                 model = body.get("model")
                 best, best_sim = None, 0.0
                 for ts, m, emb, resp in self._semantic:
@@ -309,7 +313,7 @@ class ResponseCache:
             if self.semantic_threshold is not None:
                 self._semantic.append(
                     (now, body.get("model"),
-                     _token_embed(self._conversation_text(body)), response)
+                     self._embed(self._conversation_text(body)), response)
                 )
                 if len(self._semantic) > self.max_entries:
                     self._semantic.pop(0)

@@ -1,0 +1,139 @@
+"""Standalone chat web UI — the deployable Open-WebUI stage.
+
+The reference deploys Open-WebUI on K8s as the user-facing chat front-end
+over its serving stack (``LLM_on_Kubernetes/Open-WebUI/``) and compose
+stacks for Ollama/AnythingLLM. The in-server page
+(:func:`~.api.webui_html`) covers single-server use, but is not a
+deployable unit: it lives inside one model server and cannot front the
+gateway. This module is the deployable analog, stdlib-only:
+
+- serves the same streaming chat page at ``/``;
+- reverse-proxies ``POST /v1/chat/completions`` to the gateway (SSE bytes
+  relayed chunk-by-chunk), so the browser talks same-origin — no CORS,
+  and the gateway/service mesh stays internal;
+- ``GET /health`` for probes.
+
+Deployment: ``deploy/k8s/10-webui/`` runs this as a Deployment + Service +
+Ingress pointing at the gateway Service.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from llm_in_practise_tpu.serve.api import webui_html
+
+
+class WebUI:
+    def __init__(self, gateway_url: str, *, model_name: str = "chat",
+                 timeout_s: float = 300.0):
+        self.gateway_url = gateway_url.rstrip("/")
+        self.model_name = model_name
+        self.timeout_s = timeout_s
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def serve(self, host: str = "0.0.0.0", port: int = 3000,
+              *, background: bool = False):
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, status: int, data: bytes, ctype: str):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path in ("/", "/index.html"):
+                    page = webui_html(ui.model_name).encode()
+                    return self._send(200, page, "text/html; charset=utf-8")
+                if self.path == "/health":
+                    return self._send(200, b'{"status": "ok"}',
+                                      "application/json")
+                self._send(404, b'{"error": "not found"}',
+                           "application/json")
+
+            def do_POST(self):
+                if self.path != "/v1/chat/completions":
+                    return self._send(404, b'{"error": "not found"}',
+                                      "application/json")
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b"{}"
+                req = urllib.request.Request(
+                    ui.gateway_url + "/v1/chat/completions", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    resp = urllib.request.urlopen(req, timeout=ui.timeout_s)
+                except urllib.error.HTTPError as e:
+                    return self._send(e.code, e.read() or b"{}",
+                                      "application/json")
+                except (urllib.error.URLError, TimeoutError, OSError) as e:
+                    return self._send(502, json.dumps({"error": {
+                        "message": f"gateway unreachable: {e}"}}).encode(),
+                        "application/json")
+                with resp:
+                    ctype = resp.headers.get("Content-Type",
+                                             "application/json")
+                    if "text/event-stream" in ctype:
+                        # SSE relay: forward bytes as they arrive
+                        self.send_response(resp.status)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Cache-Control", "no-store")
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+                        try:
+                            while True:
+                                chunk = resp.read(4096)
+                                if not chunk:
+                                    break
+                                self.wfile.write(chunk)
+                                self.wfile.flush()
+                        except (BrokenPipeError, ConnectionResetError):
+                            pass  # browser went away mid-stream
+                        return
+                    self._send(resp.status, resp.read(), ctype)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        bound = self._httpd.server_address
+        if background:
+            threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True).start()
+        else:
+            print(f"web ui on {bound[0]}:{bound[1]} -> {self.gateway_url}")
+            self._httpd.serve_forever()
+        return bound
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def main() -> None:
+    """Run the chat UI (``deploy/k8s/10-webui/``)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=3000)
+    p.add_argument("--gateway-url", required=True,
+                   help="base URL of the gateway (e.g. http://gateway:4000)")
+    p.add_argument("--model", default="chat",
+                   help="model/group name sent with chat requests")
+    args = p.parse_args()
+    WebUI(args.gateway_url, model_name=args.model).serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()
