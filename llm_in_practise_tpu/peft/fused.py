@@ -56,6 +56,23 @@ def fused_kernel_matmul(x, t, compute_dtype):
     return int4_matmul(x, t, compute_dtype)
 
 
+def xla_dequant_matmul(x, t, compute_dtype):
+    """The SPMD-partitionable path: dequant in plain XLA ops, fused into
+    the matmul by the compiler. Pallas custom calls are opaque to the SPMD
+    partitioner, so sharded (TP) serving of packed trees runs through this
+    (the component shardings come from :mod:`...quant.sharding`); XLA
+    emits the same psum/all-gather schedule it would for a dense kernel."""
+    from llm_in_practise_tpu.quant import int4 as int4_lib
+    from llm_in_practise_tpu.quant import nf4 as nf4_lib
+
+    if isinstance(t, NF4Tensor):
+        return x @ nf4_lib.dequantize(t, compute_dtype)
+    if isinstance(t, AWQTensor):
+        return (x * t.inv_scale.astype(x.dtype)) @ int4_lib.decode(
+            t.q, compute_dtype)
+    return x @ int4_lib.decode(t, compute_dtype)
+
+
 def qlora_fused_apply(
     model,
     qparams,
@@ -63,6 +80,7 @@ def qlora_fused_apply(
     cfg: lora_lib.LoRAConfig,
     *args,
     compute_dtype=jnp.bfloat16,
+    use_kernels: bool = True,
     **apply_kwargs,
 ):
     """Run ``model.apply`` with quantized Dense kernels served by the fused
@@ -73,7 +91,8 @@ def qlora_fused_apply(
     :func:`..peft.lora.init_lora` (may be empty — see
     :func:`fused_quant_apply`). Gradients flow through the closure to
     ``lora_params`` only (quantized bases are non-differentiable
-    storage)."""
+    storage). ``use_kernels=False`` swaps the Pallas matmuls for
+    :func:`xla_dequant_matmul` — required under a sharded mesh."""
     quant = {
         k: v for k, v in flatten_with_paths(
             qparams, is_leaf=_is_quant
@@ -114,7 +133,8 @@ def qlora_fused_apply(
             delta = lora_delta(key, x)
             return y if delta is None else (y + delta).astype(y.dtype)
         consumed.add(key)
-        y = fused_kernel_matmul(x.astype(compute_dtype), t, compute_dtype)
+        matmul = fused_kernel_matmul if use_kernels else xla_dequant_matmul
+        y = matmul(x.astype(compute_dtype), t, compute_dtype)
         delta = lora_delta(key, x)
         if delta is not None:
             y = y + delta
@@ -155,11 +175,12 @@ def make_fused_qlora_loss_fn(model, qparams, cfg: lora_lib.LoRAConfig,
 
 
 def fused_quant_apply(model, qtree, *args,
-                      compute_dtype=jnp.bfloat16, **apply_kwargs):
+                      compute_dtype=jnp.bfloat16, use_kernels: bool = True,
+                      **apply_kwargs):
     """Serve a PTQ-quantized model (Int4/AWQ/NF4 kernel leaves) through the
     fused kernels — no adapters; the W4A16 serving path
     (vLLM ``compressed-tensors`` consumption parity)."""
     return qlora_fused_apply(
         model, qtree, {}, lora_lib.LoRAConfig(), *args,
-        compute_dtype=compute_dtype, **apply_kwargs,
+        compute_dtype=compute_dtype, use_kernels=use_kernels, **apply_kwargs,
     )

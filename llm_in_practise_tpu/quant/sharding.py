@@ -1,0 +1,156 @@
+"""Sharding packed quantized trees — TP/FSDP serving of NF4/W4A16 models.
+
+The reference serves quantized exports under tensor parallelism through
+vLLM (``vllm serve ... --tensor-parallel-size 2`` on the GPTQ/AWQ exports —
+``Fine-Tuning/README.md:345-349``): each rank loads its slice of the packed
+weights. Here the same placement is expressed the JAX way: every component
+array of a packed leaf (:class:`~.nf4.NF4Tensor`,
+:class:`~.int4.Int4Tensor`, :class:`~.awq.AWQTensor`) gets a
+``NamedSharding`` **derived from the partition spec the bf16 weight would
+have** under the strategy rule table (:mod:`...parallel.strategy`), and
+XLA's SPMD partitioner compiles the dequant+matmul with the same
+collectives it would emit for a dense kernel.
+
+The mapping must respect each format's internal blocking:
+
+- ``Int4Tensor`` (``packed (in/2, out)``, groups along *in*): out-sharding
+  maps directly onto every component's last axis; in-sharding maps onto
+  the first axes when the per-shard rows stay group-aligned.
+- ``NF4Tensor`` ``kblock`` (``packed (K, N/2)`` split-half nibble pairing,
+  64-blocks along K, double-quantized absmax): K-sharding maps onto packed
+  rows / flat absmax ranges when block-aligned. N-sharding of ``packed``
+  is still annotated — the split-half pairing means a device's packed
+  columns decode to a non-contiguous set of output columns, which is a
+  *layout*, not a semantics problem: the partitioner keeps the program
+  equivalent and inserts the (cheap, N/2-contiguous) reshards it needs.
+  The small absmax sidecars replicate whenever alignment would be lost —
+  correctness never depends on the annotation, only memory/traffic does.
+
+Correctness therefore holds for ANY mesh: shardings only steer placement.
+Sharded serving uses the pure-XLA dequant path
+(``fused.qlora_fused_apply(use_kernels=False)``) — a Pallas custom call is
+opaque to the SPMD partitioner, so the fused kernels stay the single-chip
+fast path while multi-chip lowers dequant into partitioned einsums
+(see ``serve/quantized.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+import jax
+
+from llm_in_practise_tpu.parallel import strategy as strategy_lib
+from llm_in_practise_tpu.quant.awq import AWQTensor
+from llm_in_practise_tpu.quant.int4 import Int4Tensor
+from llm_in_practise_tpu.quant.nf4 import BLOCK, SCALE_BLOCK, NF4Tensor
+from llm_in_practise_tpu.utils.tree import path_str
+
+P = PartitionSpec
+QUANT_LEAVES = (NF4Tensor, Int4Tensor, AWQTensor)
+
+
+def _axis_size(mesh: Mesh, entry) -> int:
+    names = entry if isinstance(entry, tuple) else (entry,)
+    return int(np.prod([mesh.shape[n] for n in names]))
+
+
+def _spec01(spec: PartitionSpec, mesh: Mesh):
+    """First two spec entries with size-1 mesh axes dropped — a serving
+    mesh keeps e.g. ``fsdp=1`` in the rule specs, and a trivial axis must
+    not shadow the branch that shards the real one."""
+    def norm(entry):
+        if entry is None or _axis_size(mesh, entry) == 1:
+            return None
+        return entry
+
+    entries = tuple(spec) + (None, None)
+    return norm(entries[0]), norm(entries[1])
+
+
+def nf4_shardings(t: NF4Tensor, spec: PartitionSpec, mesh: Mesh) -> NF4Tensor:
+    """Component shardings for one NF4 leaf (returned in an NF4Tensor shell
+    so the shardings tree matches the params treedef)."""
+    rep = NamedSharding(mesh, P())
+    packed = absq = ascale = rep
+    if t.layout == "kblock":
+        k, n = t.shape
+        a0, a1 = _spec01(spec, mesh)
+        if a0 is not None:
+            s = _axis_size(mesh, a0)
+            if k % s == 0 and (k // s) % BLOCK == 0:
+                packed = NamedSharding(mesh, P(a0, None))
+                if (k // BLOCK) % s == 0:
+                    absq = NamedSharding(mesh, P(a0))
+                    per_shard = (k // BLOCK // s) * n
+                    if (per_shard % SCALE_BLOCK == 0
+                            and t.absmax_scale.shape[0] % s == 0):
+                        ascale = NamedSharding(mesh, P(a0))
+        elif a1 is not None:
+            s = _axis_size(mesh, a1)
+            if (n // 2) % s == 0:
+                # packed columns i ↔ weight columns (i, n/2+i): a shard is a
+                # fixed permutation of output columns — see module docstring
+                packed = NamedSharding(mesh, P(None, a1))
+    return NF4Tensor(packed, absq, ascale, rep,
+                     shape=t.shape, layout=t.layout)
+
+
+def int4_shardings(t: Int4Tensor, spec: PartitionSpec, mesh: Mesh) -> Int4Tensor:
+    rep = NamedSharding(mesh, P())
+    packed = scales = zeros = rep
+    d_in, _ = t.shape
+    a0, a1 = _spec01(spec, mesh)
+    if a1 is not None:
+        packed = NamedSharding(mesh, P(None, a1))
+        scales = zeros = NamedSharding(mesh, P(None, a1))
+    elif a0 is not None:
+        s = _axis_size(mesh, a0)
+        if ((d_in // 2) % s == 0
+                and (d_in // s) % t.group_size == 0):
+            packed = NamedSharding(mesh, P(a0, None))
+            if t.scales.shape[0] % s == 0:
+                scales = zeros = NamedSharding(mesh, P(a0, None))
+    return Int4Tensor(packed, scales, zeros,
+                      group_size=t.group_size, shape=t.shape)
+
+
+def awq_shardings(t: AWQTensor, spec: PartitionSpec, mesh: Mesh) -> AWQTensor:
+    a0, _ = _spec01(spec, mesh)
+    inv = NamedSharding(mesh, P())
+    if a0 is not None and t.shape[0] % _axis_size(mesh, a0) == 0:
+        inv = NamedSharding(mesh, P(a0))
+    return AWQTensor(int4_shardings(t.q, spec, mesh), inv)
+
+
+def quant_tree_shardings(qtree, mesh: Mesh,
+                         rules=strategy_lib.DEFAULT_RULES):
+    """NamedSharding pytree for a mixed packed/dense params tree.
+
+    Dense leaves get the rule table's spec directly (as in
+    :func:`...parallel.strategy.param_shardings`); packed leaves get
+    component shardings derived from the spec their *logical* (in, out)
+    weight shape matches.
+    """
+    def leaf(path, v):
+        ps = path_str(path)
+        if isinstance(v, QUANT_LEAVES):
+            spec = strategy_lib.spec_for(ps, tuple(v.shape), mesh, rules)
+            if isinstance(v, NF4Tensor):
+                return nf4_shardings(v, spec, mesh)
+            if isinstance(v, AWQTensor):
+                return awq_shardings(v, spec, mesh)
+            return int4_shardings(v, spec, mesh)
+        spec = strategy_lib.spec_for(ps, np.shape(v), mesh, rules)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(
+        leaf, qtree, is_leaf=lambda x: isinstance(x, QUANT_LEAVES))
+
+
+def shard_quant_tree(qtree, mesh: Mesh, rules=strategy_lib.DEFAULT_RULES):
+    """Place a packed tree for sharded serving — the vLLM per-rank weight
+    load, as one ``device_put`` (reference ``Fine-Tuning/README.md:345-349``,
+    TP=2 quantized serving)."""
+    return jax.device_put(qtree, quant_tree_shardings(qtree, mesh, rules))

@@ -26,11 +26,26 @@ from llm_in_practise_tpu.peft.fused import fused_quant_apply
 
 class QuantizedModel:
     """Model facade: ``apply({"params": qtree}, ...)`` serves the packed
-    tree through the fused kernels; everything else delegates."""
+    tree through the fused kernels; everything else delegates.
 
-    def __init__(self, model, *, compute_dtype=jnp.bfloat16):
+    ``mesh``: pass the serving mesh to run sharded (TP) — the packed tree
+    should then be placed with
+    :func:`~llm_in_practise_tpu.quant.sharding.shard_quant_tree` and the
+    forward switches to the SPMD-partitionable XLA dequant path (Pallas
+    custom calls are opaque to the partitioner). Matches vLLM's TP=2
+    quantized serving (reference ``Fine-Tuning/README.md:345-349``).
+    ``use_kernels`` overrides the automatic choice."""
+
+    def __init__(self, model, *, compute_dtype=jnp.bfloat16, mesh=None,
+                 use_kernels: bool | None = None):
         self.model = model
         self.compute_dtype = compute_dtype
+        if use_kernels is None:
+            use_kernels = mesh is None or all(
+                mesh.shape[n] == 1 for n in mesh.shape
+                if n not in ("data",)
+            )
+        self.use_kernels = use_kernels
 
     @property
     def config(self):
@@ -42,5 +57,6 @@ class QuantizedModel:
     def apply(self, variables, *args, **kwargs):
         return fused_quant_apply(
             self.model, variables["params"], *args,
-            compute_dtype=self.compute_dtype, **kwargs,
+            compute_dtype=self.compute_dtype,
+            use_kernels=self.use_kernels, **kwargs,
         )
