@@ -1,0 +1,62 @@
+"""Generate the committed Qwen3 golden fixture (run once; artifact is tiny).
+
+Ground truth is the *torch transformers* Qwen3 implementation — the exact
+stack the reference fine-tunes with (``Fine-Tuning/qwen3-8b-lora.py:114-120``
+``AutoModelForCausalLM.from_pretrained``) — so the fidelity test validates
+our loader's name mapping / transpose conventions and our flax model's math
+against the real thing, not against our own save path.
+
+Usage (CPU, deterministic):
+    python tests/fixtures/make_qwen3_golden.py
+
+Emits into ``tests/fixtures/qwen3_tiny/``:
+    config.json + model.safetensors   — HF-format checkpoint (~1 MB)
+    golden_input.npy                  — (2, 24) int32 token ids
+    golden_logits.npy                 — (2, 24, vocab) f32 torch logits
+"""
+
+import os
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "qwen3_tiny")
+
+
+def main() -> None:
+    import torch
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(0)
+    cfg = Qwen3Config(
+        vocab_size=160,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=64,
+        rope_theta=1_000_000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_dropout=0.0,
+        use_cache=False,
+        torch_dtype="float32",
+    )
+    model = Qwen3ForCausalLM(cfg).eval()
+    os.makedirs(OUT, exist_ok=True)
+    model.save_pretrained(OUT, safe_serialization=True)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 24)).astype(np.int64)
+    with torch.no_grad():
+        logits = model(torch.from_numpy(ids)).logits.numpy()
+    np.save(os.path.join(OUT, "golden_input.npy"), ids.astype(np.int32))
+    np.save(os.path.join(OUT, "golden_logits.npy"),
+            logits.astype(np.float32))
+    print("wrote", OUT, "logits", logits.shape,
+          "|mean|", float(np.abs(logits).mean()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""Qwen3 fidelity against a real HF-format checkpoint + torch goldens.
+
+The committed fixture (``tests/fixtures/qwen3_tiny/``) was produced by the
+*torch transformers* Qwen3 implementation (see ``fixtures/
+make_qwen3_golden.py``) — the reference's own load path
+(``Fine-Tuning/qwen3-8b-lora.py:114-120``). These tests therefore validate
+the HF name mapping / (out,in)→(in,out) transposes in
+``models/hf_loader.py`` and the flax model's math (QK-norm, GQA, RoPE
+theta, SwiGLU, RMSNorm) against an independent implementation — a
+roundtrip through our own save path cannot catch a convention error that
+is symmetric in save and load.
+
+Also covers SURVEY hard-part #3 (shard-on-load): tensors stream one at a
+time to their mesh shardings, never staging the full tree on host.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_in_practise_tpu.models.hf_loader import load_qwen3, save_qwen3
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "qwen3_tiny")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    ids = np.load(os.path.join(FIXTURE, "golden_input.npy"))
+    logits = np.load(os.path.join(FIXTURE, "golden_logits.npy"))
+    return ids, logits
+
+
+def test_loader_logits_match_torch_goldens(golden):
+    ids, want = golden
+    model, params = load_qwen3(
+        FIXTURE, dtype=jnp.float32,
+        config_overrides={"compute_dtype": "float32"})
+    got = jax.jit(
+        lambda p, x: model.apply({"params": p}, x, deterministic=True)
+    )(params, jnp.asarray(ids))
+    # two independent f32 implementations; rounding differs at ~1e-5
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_greedy_next_tokens_match_torch(golden):
+    ids, want = golden
+    model, params = load_qwen3(
+        FIXTURE, dtype=jnp.float32,
+        config_overrides={"compute_dtype": "float32"})
+    got = model.apply({"params": params}, jnp.asarray(ids),
+                      deterministic=True)
+    np.testing.assert_array_equal(
+        np.argmax(np.asarray(got), -1), np.argmax(want, -1))
+
+
+def test_roundtrip_save_preserves_goldens(tmp_path, golden):
+    """Export through save_qwen3 and reload: still matches torch — pins the
+    save path to the same (asymmetric-checked) conventions."""
+    ids, want = golden
+    model, params = load_qwen3(
+        FIXTURE, dtype=jnp.float32,
+        config_overrides={"compute_dtype": "float32"})
+    save_qwen3(params, model.cfg, str(tmp_path))
+    model2, params2 = load_qwen3(
+        str(tmp_path), dtype=jnp.float32,
+        config_overrides={"compute_dtype": "float32"})
+    got = model2.apply({"params": params2}, jnp.asarray(ids),
+                       deterministic=True)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_shard_on_load_places_tensors_on_mesh(golden, devices):
+    """sharding_fn streams each tensor straight to its mesh placement —
+    the 14B-without-host-OOM load path, checked for placement here and
+    for host-staging behavior in test_shard_on_load_host_staging."""
+    from llm_in_practise_tpu.core import mesh as mesh_lib
+    from llm_in_practise_tpu.parallel.strategy import spec_for, DEFAULT_RULES
+    from jax.sharding import NamedSharding
+
+    ids, want = golden
+    mesh = mesh_lib.build_mesh(
+        mesh_lib.MeshSpec(data=1, fsdp=4, model=2), devices=devices)
+
+    def sharding_fn(path, shape):
+        return NamedSharding(mesh, spec_for(path, shape, mesh, DEFAULT_RULES))
+
+    model, params = load_qwen3(
+        FIXTURE, dtype=jnp.float32, sharding_fn=sharding_fn,
+        config_overrides={"compute_dtype": "float32"})
+    # at least the big kernels must actually be sharded, not replicated
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    sharded = ["/".join(str(getattr(k, "key", k)) for k in p)
+               for p, v in flat
+               if not v.sharding.is_fully_replicated]
+    assert any("gate_proj" in s for s in sharded), sharded
+    with mesh:
+        got = jax.jit(
+            lambda p, x: model.apply({"params": p}, x, deterministic=True)
+        )(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_shard_on_load_host_staging_bounded(tmp_path):
+    """The loader must stage at most one tensor on host at a time: peak
+    *new* host allocations during a sharded load stay far below the
+    checkpoint size (SURVEY hard-part #3, scaled down)."""
+    import tracemalloc
+
+    from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
+
+    cfg = Qwen3Config(vocab_size=4096, hidden_size=512,
+                      intermediate_size=2048, n_layer=4, n_head=8,
+                      n_kv_head=4, head_dim=64, max_seq_len=64,
+                      tie_word_embeddings=False)
+    model = Qwen3(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    save_qwen3(params, cfg, str(tmp_path))
+    ckpt_bytes = os.path.getsize(os.path.join(tmp_path, "model.safetensors"))
+    assert ckpt_bytes > 20e6  # the bound below is only meaningful at size
+
+    devices = jax.devices()
+    from llm_in_practise_tpu.core import mesh as mesh_lib
+    from llm_in_practise_tpu.parallel.strategy import spec_for, DEFAULT_RULES
+    from jax.sharding import NamedSharding
+
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(fsdp=len(devices)),
+                               devices=devices)
+
+    def sharding_fn(path, shape):
+        return NamedSharding(mesh, spec_for(path, shape, mesh, DEFAULT_RULES))
+
+    tracemalloc.start()
+    load_qwen3(str(tmp_path), dtype=jnp.float32, sharding_fn=sharding_fn)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # python-level staging (numpy buffers) must stay ~one-tensor-sized;
+    # a loader that materialized the whole host tree would peak >= ckpt
+    assert peak < ckpt_bytes * 0.5, (peak, ckpt_bytes)
