@@ -26,12 +26,17 @@ class SFTRecipe:
 
     # model
     model_dir: str | None = None          # HF dir; None -> tiny in-tree Qwen3
-    # dataset
-    dataset: str = "self_cognition"       # or a path to an alpaca .json
+    # dataset: a registered name (see dataset_registry), "self_cognition",
+    # or a direct path to an alpaca .json
+    dataset: str = "self_cognition"
+    # LLaMA-Factory dataset_info.json analog: {name: {path, format}};
+    # paths resolve relative to the registry file
+    dataset_registry: str | None = None
     bot_name: str = "MyBot"
     bot_author: str = "MyTeam"
     cutoff_len: int = 128                 # max_length
-    # method
+    # method: "lora" (bf16/f32 base) or "qlora" (NF4-quantized frozen base —
+    # the reference's deepseek-r1-0528-qwen3-8b-qlora.dist.py path)
     finetuning_type: str = "lora"
     lora_rank: int = 8
     lora_alpha: float = 16.0
@@ -82,8 +87,35 @@ def main():
 
     os.makedirs(recipe.output_dir, exist_ok=True)
 
+    # --- dataset registration (dataset_info.json analog) ---------------------
+    dataset = recipe.dataset
+    if recipe.dataset_registry:
+        with open(recipe.dataset_registry, encoding="utf-8") as f:
+            registry = json.load(f)
+        entry = registry.get(dataset)
+        if entry is not None:
+            fmt = entry.get("format", "alpaca")
+            if fmt not in ("alpaca", "self_cognition"):
+                raise ValueError(f"unknown dataset format {fmt!r}")
+            if fmt == "self_cognition":
+                dataset = "self_cognition"
+            else:
+                path = entry.get("path")
+                if not path:
+                    raise ValueError(
+                        f"registry entry {recipe.dataset!r} has format "
+                        f"{fmt!r} but no 'path'")
+                dataset = os.path.join(
+                    os.path.dirname(os.path.abspath(recipe.dataset_registry)),
+                    path)
+            print(f"dataset {recipe.dataset!r} -> {dataset} ({fmt})")
+        elif dataset != "self_cognition" and not os.path.exists(dataset):
+            raise ValueError(
+                f"dataset {dataset!r} is neither registered in "
+                f"{recipe.dataset_registry} nor a file")
+
     # --- dataset -------------------------------------------------------------
-    if recipe.dataset == "self_cognition":
+    if dataset == "self_cognition":
         records = self_cognition_records(n=64)
         tok = build_tokenizer(records, recipe.bot_name, recipe.bot_author,
                               os.path.join(recipe.output_dir, "tokenizer.json"))
@@ -91,7 +123,7 @@ def main():
                                   author=recipe.bot_author,
                                   max_length=recipe.cutoff_len)
     else:
-        with open(recipe.dataset, encoding="utf-8") as f:
+        with open(dataset, encoding="utf-8") as f:
             alpaca = json.load(f)
         texts = [render_chatml(alpaca_to_messages(r)) for r in alpaca]
         from llm_in_practise_tpu.data import BPETokenizer
@@ -135,12 +167,33 @@ def main():
     lora = init_lora(params, lcfg, jax.random.PRNGKey(1))
     print(trainable_report(params, lora))
 
+    # qlora: NF4-quantize the frozen base (reference
+    # ``deepseek-r1-0528-qwen3-8b-qlora.dist.py`` BitsAndBytesConfig path);
+    # the dequant runs inside the jitted loss, grads reach LoRA only
+    if recipe.finetuning_type == "qlora":
+        from llm_in_practise_tpu.peft.qlora import (
+            memory_report, qlora_apply, quantize_base,
+        )
+
+        qparams = jax.jit(quantize_base)(params)
+        print(memory_report(params, qparams))
+        compute = jnp.dtype(cfg.compute_dtype)
+
+        def effective(lp):
+            return qlora_apply(qparams, lp, lcfg, dtype=compute)
+    elif recipe.finetuning_type == "lora":
+        def effective(lp):
+            return apply_lora(params, lp, lcfg)
+    else:
+        raise ValueError(
+            f"unknown finetuning_type {recipe.finetuning_type!r}")
+
     # --- train ---------------------------------------------------------------
     x = jnp.asarray(batch.input_ids)
     labels = jnp.asarray(batch.labels)
 
     def loss_fn(lp, idx):
-        logits = model.apply({"params": apply_lora(params, lp, lcfg)},
+        logits = model.apply({"params": effective(lp)},
                              x[idx], deterministic=True)
         lab = labels[idx]
         shift_logits = logits[:, :-1].astype(jnp.float32)

@@ -1,0 +1,62 @@
+"""Smoke tests for the recipe-driven SFT flow (LLaMA-Factory analog):
+both shipped recipes run end-to-end through examples/sft_recipe.py —
+dataset registration, LoRA and QLoRA methods, adapter/merge outputs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECIPES = os.path.join(REPO, "examples", "recipes")
+
+
+def _run_recipe(tmp_path, base_recipe: str, **overrides):
+    with open(os.path.join(RECIPES, base_recipe)) as f:
+        recipe = json.load(f)
+    recipe.update(output_dir=str(tmp_path / "out"), num_train_steps=4,
+                  **overrides)
+    # registry path in the shipped recipe is repo-relative
+    if "dataset_registry" in recipe:
+        recipe["dataset_registry"] = os.path.join(
+            REPO, recipe["dataset_registry"])
+    rpath = tmp_path / "recipe.json"
+    rpath.write_text(json.dumps(recipe))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "sft_recipe.py"),
+         "--recipe", str(rpath)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return recipe, proc.stdout
+
+
+def test_lora_sft_recipe_runs(tmp_path):
+    recipe, out = _run_recipe(tmp_path, "lora_sft.json")
+    assert "trainable params" in out
+    assert os.path.exists(os.path.join(recipe["output_dir"],
+                                       "adapter.msgpack"))
+    # merge_after in the shipped recipe exports the merged model too
+    assert os.path.exists(os.path.join(recipe["output_dir"],
+                                       "model.msgpack"))
+
+
+def test_deepseek_r1_qlora_recipe_runs(tmp_path):
+    recipe, out = _run_recipe(tmp_path, "deepseek_r1_qwen3_qlora.json")
+    # dataset came through the registry, not a literal path
+    assert "alpaca_reasoning_demo" in out
+    # the NF4 quantization actually happened (memory_report line)
+    assert "NF4" in out
+    assert os.path.exists(os.path.join(recipe["output_dir"],
+                                       "adapter.msgpack"))
+
+
+def test_registry_rejects_unknown_dataset(tmp_path):
+    with pytest.raises(AssertionError) as e:
+        _run_recipe(tmp_path, "deepseek_r1_qwen3_qlora.json",
+                    dataset="no_such_set")
+    assert "neither registered" in str(e.value)
