@@ -68,7 +68,12 @@ def matmul_param_count(params, *, tied_head: bool) -> int:
     n = 0
     embed_size = 0
     for path, leaf in flatten_with_paths(params).items():
-        if getattr(leaf, "ndim", 0) != 2:
+        # kernels only — in stacked (scan/MoE) layouts norm scales are
+        # 2-D too, but they never hit the MXU. 3-D kernels' full size is
+        # the per-token matmul weight count.
+        if not (path.endswith("/kernel") or path.endswith("/embedding")):
+            continue
+        if getattr(leaf, "ndim", 0) not in (2, 3):
             continue
         if "tok_embed" in path or "pos_embed" in path:
             embed_size = max(embed_size, leaf.size)
@@ -122,13 +127,19 @@ def check_mfu(name: str, mfu: float) -> None:
 def bench_qlora(peak: float) -> dict:
     from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
     from llm_in_practise_tpu.peft import lora as lora_lib
-    from llm_in_practise_tpu.peft.fused import make_fused_qlora_loss_fn
-    from llm_in_practise_tpu.peft.qlora import quantize_base
+    from llm_in_practise_tpu.peft.qlora import (
+        qlora_apply,
+        quantize_base_lowmem,
+    )
     from llm_in_practise_tpu.train.losses import fused_linear_cross_entropy
 
     SEQ = 1024
     # Qwen3-1.7B-shaped (hidden 2048 / inter 6144 / 28 layers / GQA 16:8,
     # vocab 151936, tied) — sized to fill one v5e chip's HBM as NF4 + remat.
+    # scan_layers is load-bearing: the unrolled 28-layer HLO takes >40 min
+    # through the AOT compile service; the scanned program compiles one
+    # block. The NF4 base dequantizes inside the jitted step (the Pallas
+    # fused kernel can't slice stacked scan weights per iteration).
     # Smaller fallback if the compile service rejects the program.
     shapes = [
         dict(hidden_size=2048, intermediate_size=6144, n_layer=28,
@@ -141,7 +152,7 @@ def bench_qlora(peak: float) -> dict:
         try:
             cfg = Qwen3Config(
                 vocab_size=151936, max_seq_len=SEQ, rope_theta=1e6,
-                tie_word_embeddings=True, remat=True,
+                tie_word_embeddings=True, remat=True, scan_layers=True,
                 compute_dtype="bfloat16", **shape,
             )
             model = Qwen3(cfg)
@@ -156,29 +167,22 @@ def bench_qlora(peak: float) -> dict:
                 lambda p: lora_lib.init_lora(p, lcfg, jax.random.PRNGKey(1))
             )(params)
 
-            # ONE jitted program for quantize+cast: eagerly, every tiny op
-            # would be its own remote compile under the axon tunnel (minutes
-            # to hours); under jit it is a single compilation.
-            def quantize_and_cast(p):
-                q = quantize_base(p)
-                # un-quantized big leaves (the embedding) drop to bf16:
-                # consumed in bf16 anyway; f32 residency wastes ~600 MB HBM
-                return jax.tree.map(
-                    lambda v: v.astype(jnp.bfloat16)
-                    if v.dtype == jnp.float32 and v.size > 1e6 else v, q)
-
-            qparams = jax.jit(quantize_and_cast)(params)
+            # per-leaf jitted quantize with donation: one whole-tree
+            # program OOMs HBM on multi-B trees, and eager ops would each
+            # be their own remote compile under the axon tunnel
+            qparams = quantize_base_lowmem(params)
             del params  # only the NF4 tree stays resident
 
-            def base_loss(apply_out, batch, rng):
+            def loss_fn(lp, batch, rng):
+                eff = qlora_apply(qparams, lp, lcfg)
                 x, y = batch
-                hidden = apply_out(x, return_hidden=True)
-                head_w = qparams["tok_embed"]["embedding"]
+                hidden = model.apply({"params": eff}, x,
+                                     deterministic=True, return_hidden=True)
                 loss, _ = fused_linear_cross_entropy(
-                    hidden, head_w, y, transpose_weight=True, chunk=2048)
+                    hidden, eff["tok_embed"]["embedding"], y,
+                    transpose_weight=True, chunk=2048)
                 return loss
 
-            loss_fn = make_fused_qlora_loss_fn(model, qparams, lcfg, base_loss)
             tx = optax.adamw(1e-4)
             opt_state = tx.init(lora)
 

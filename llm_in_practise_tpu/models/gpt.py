@@ -104,7 +104,8 @@ class GPT(nn.Module):
                 norm_first=cfg.norm_first, activation=cfg.activation,
                 use_rope=cfg.pos_embedding == "rope",
                 rope_theta=cfg.rope_theta, max_seq_len=cfg.seq_len,
-                attn_impl=cfg.attn_impl, name=f"block_{i}",
+                attn_impl=cfg.attn_impl, dtype=compute_dtype,
+                name=f"block_{i}",
             )
             if cfg.remat and cache is None:
                 # gradient checkpointing (reference

@@ -105,6 +105,11 @@ class CausalSelfAttention(nn.Module):
     rope_theta: float = 10000.0
     max_seq_len: int = 2048
     attn_impl: str = "auto"
+    # Compute dtype for the projections. flax Dense with dtype=None
+    # PROMOTES bf16 activations against the f32 params — the whole layer
+    # silently runs f32 and the MXU loses its bf16 peak; pass bfloat16
+    # here (params stay f32 masters, cast per-call).
+    dtype: object = None
 
     @nn.compact
     def __call__(
@@ -118,7 +123,8 @@ class CausalSelfAttention(nn.Module):
         b, l, _ = x.shape
         head_dim = self.embed_dim // self.n_head
         qkv_dense = lambda name: nn.Dense(
-            self.embed_dim, kernel_init=dense_init, name=name
+            self.embed_dim, kernel_init=dense_init, dtype=self.dtype,
+            name=name
         )
         q = qkv_dense("q_proj")(x).reshape(b, l, self.n_head, head_dim)
         k = qkv_dense("k_proj")(x).reshape(b, l, self.n_head, head_dim)
@@ -130,8 +136,13 @@ class CausalSelfAttention(nn.Module):
             )
             if positions is None and cache is not None:
                 positions = cache_positions(cache["index"], b, l)
-            q = rope_ops.apply_rotary_emb(q, cos, sin, positions=positions)
-            k = rope_ops.apply_rotary_emb(k, cos, sin, positions=positions)
+            # rotation math in f32 (the tables are f32), result back in
+            # the compute dtype so attention keeps its bf16 path
+            dt = q.dtype
+            q = rope_ops.apply_rotary_emb(
+                q, cos, sin, positions=positions).astype(dt)
+            k = rope_ops.apply_rotary_emb(
+                k, cos, sin, positions=positions).astype(dt)
 
         q_offset = None
         if cache is not None:
@@ -155,7 +166,8 @@ class CausalSelfAttention(nn.Module):
             impl=self.attn_impl,
         )
         out = out.reshape(b, l, self.embed_dim)
-        out = nn.Dense(self.embed_dim, kernel_init=dense_init, name="out_proj")(out)
+        out = nn.Dense(self.embed_dim, kernel_init=dense_init,
+                       dtype=self.dtype, name="out_proj")(out)
         out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
         return out, cache
 
@@ -167,12 +179,15 @@ class MLP(nn.Module):
     hidden_dim: int
     dropout: float = 0.0
     activation: str = "gelu"
+    dtype: object = None  # see CausalSelfAttention.dtype
 
     @nn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
-        h = nn.Dense(self.hidden_dim, kernel_init=dense_init, name="fc_in")(x)
+        h = nn.Dense(self.hidden_dim, kernel_init=dense_init,
+                     dtype=self.dtype, name="fc_in")(x)
         h = _activation(self.activation)(h)
-        h = nn.Dense(self.embed_dim, kernel_init=dense_init, name="fc_out")(h)
+        h = nn.Dense(self.embed_dim, kernel_init=dense_init,
+                     dtype=self.dtype, name="fc_out")(h)
         return nn.Dropout(self.dropout)(h, deterministic=deterministic)
 
 
@@ -189,6 +204,7 @@ class TransformerBlock(nn.Module):
     rope_theta: float = 10000.0
     max_seq_len: int = 2048
     attn_impl: str = "auto"
+    dtype: object = None  # see CausalSelfAttention.dtype
 
     @nn.compact
     def __call__(
@@ -203,14 +219,23 @@ class TransformerBlock(nn.Module):
             self.embed_dim, self.n_head, self.dropout,
             use_rope=self.use_rope, rope_theta=self.rope_theta,
             max_seq_len=self.max_seq_len, attn_impl=self.attn_impl,
-            name="attn",
+            dtype=self.dtype, name="attn",
         )
         mlp = MLP(
             self.embed_dim, int(self.embed_dim * self.mlp_ratio),
-            self.dropout, self.activation, name="mlp",
+            self.dropout, self.activation, dtype=self.dtype, name="mlp",
         )
-        ln1 = nn.LayerNorm(name="ln1")
-        ln2 = nn.LayerNorm(name="ln2")
+
+        def _ln(name):
+            # statistics in f32 (dtype=None promotes), output back in the
+            # block's compute dtype so residuals stay bf16
+            ln = nn.LayerNorm(name=name)
+            if self.dtype is None:
+                return ln
+            return lambda v: ln(v).astype(self.dtype)
+
+        ln1 = _ln("ln1")
+        ln2 = _ln("ln2")
         if self.norm_first:
             a, cache = attn(
                 ln1(x), deterministic=deterministic, cache=cache, positions=positions

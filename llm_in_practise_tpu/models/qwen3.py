@@ -48,6 +48,14 @@ class Qwen3Config:
     attn_impl: str = "auto"
     compute_dtype: str = "bfloat16"
     remat: bool = False  # gradient checkpointing: recompute blocks in bwd
+    # Compile one block and lax.scan it over the depth axis: XLA program
+    # size (and compile time) becomes O(1) in n_layer instead of O(n) —
+    # at 28+ layers the unrolled HLO takes tens of minutes to compile.
+    # Params are stored STACKED (leading n_layer axis, under "blocks");
+    # use stack_layer_params / unstack_layer_params to convert to/from
+    # the unrolled per-block layout (HF interop, cached decode).
+    # Training-path only: cached decode uses the unrolled layout.
+    scan_layers: bool = False
 
     def replace(self, **kw) -> "Qwen3Config":
         return dataclasses.replace(self, **kw)
@@ -135,7 +143,12 @@ class Qwen3Attention(nn.Module):
     ) -> tuple[jax.Array, Cache | None]:
         cfg = self.cfg
         b, l, _ = x.shape
-        dense = lambda feats, name: nn.Dense(feats, use_bias=False, name=name)
+        # dtype pins the compute path: flax Dense with dtype=None promotes
+        # bf16 activations against f32 params and the layer silently runs
+        # f32 (params stay f32 masters either way)
+        compute = jnp.dtype(cfg.compute_dtype)
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=compute, name=name)
         q = dense(cfg.n_head * cfg.head_dim, "q_proj")(x)
         k = dense(cfg.n_kv_head * cfg.head_dim, "k_proj")(x)
         v = dense(cfg.n_kv_head * cfg.head_dim, "v_proj")(x)
@@ -151,8 +164,14 @@ class Qwen3Attention(nn.Module):
         if positions is None and cache is not None:
             positions = layers.cache_positions(cache["index"], b, l)
         # HF rotate_half lane layout — required for checkpoint fidelity.
-        q = rope_ops.apply_rotary_emb(q, cos, sin, positions=positions, interleaved=False)
-        k = rope_ops.apply_rotary_emb(k, cos, sin, positions=positions, interleaved=False)
+        # Rotation math rides the f32 tables; result returns to the
+        # compute dtype so attention keeps its bf16 MXU path.
+        q = rope_ops.apply_rotary_emb(
+            q, cos, sin, positions=positions, interleaved=False
+        ).astype(compute)
+        k = rope_ops.apply_rotary_emb(
+            k, cos, sin, positions=positions, interleaved=False
+        ).astype(compute)
 
         q_offset = None
         if cache is not None:
@@ -186,11 +205,12 @@ class Qwen3MLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
-        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj")(x)
-        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj")(x)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj")(
-            nn.silu(gate) * up
-        )
+        compute = jnp.dtype(cfg.compute_dtype)  # see Qwen3Attention
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=False, dtype=compute, name=name)
+        gate = dense(cfg.intermediate_size, "gate_proj")(x)
+        up = dense(cfg.intermediate_size, "up_proj")(x)
+        return dense(cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
 
 
 class Qwen3Block(nn.Module):
@@ -213,6 +233,42 @@ class Qwen3Block(nn.Module):
         x = x + a
         x = x + Qwen3MLP(cfg, name="mlp")(RMSNorm(cfg.rms_norm_eps, name="ln2")(x))
         return x, cache
+
+
+class _ScanBody(nn.Module):
+    """One scan step: positional-only signature for ``nn.scan`` (carry = the
+    hidden stream; rope tables and positions ride as broadcast inputs)."""
+
+    cfg: Qwen3Config
+
+    @nn.compact
+    def __call__(self, x, rope_tables, positions):
+        block_cls = (
+            nn.remat(Qwen3Block, prevent_cse=False)
+            if self.cfg.remat else Qwen3Block
+        )
+        x, _ = block_cls(self.cfg, name="block")(
+            x, rope_tables, cache=None, positions=positions)
+        return x, None
+
+
+def stack_layer_params(params: dict, n_layer: int) -> dict:
+    """Unrolled ``block_i`` subtrees -> the scan layout (stacked leaves
+    with a leading ``n_layer`` axis under ``blocks/block``)."""
+    rest = {k: v for k, v in params.items()
+            if not k.startswith("block_")}
+    blocks = [params[f"block_{i}"] for i in range(n_layer)]
+    stacked = jax.tree.map(lambda *ls: jnp.stack(ls, axis=0), *blocks)
+    return {**rest, "blocks": {"block": stacked}}
+
+
+def unstack_layer_params(params: dict, n_layer: int) -> dict:
+    """Scan layout -> unrolled ``block_i`` subtrees (serving / HF export)."""
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+    stacked = params["blocks"]["block"]
+    for i in range(n_layer):
+        rest[f"block_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return rest
 
 
 class Qwen3(nn.Module):
@@ -242,20 +298,38 @@ class Qwen3(nn.Module):
             cfg.head_dim, cfg.max_seq_len, cfg.rope_theta
         )
         new_caches: list[Cache] | None = [] if cache is not None else None
-        for i in range(cfg.n_layer):
-            layer_cache = cache[i] if cache is not None else None
-            block = Qwen3Block(cfg, name=f"block_{i}")
-            if cfg.remat and cache is None:
-                # gradient checkpointing (the reference fine-tunes all call
-                # gradient_checkpointing_enable — qwen3-8b-lora.py:128-144)
-                x = layers.remat_apply(
-                    block, x, rope_tables, cache=None, positions=positions)
-            else:
-                x, layer_cache = block(
-                    x, rope_tables, cache=layer_cache, positions=positions
-                )
-            if new_caches is not None:
-                new_caches.append(layer_cache)
+        if cfg.scan_layers:
+            if cache is not None:
+                raise NotImplementedError(
+                    "scan_layers is the training-path layout; convert with "
+                    "unstack_layer_params(...) and scan_layers=False for "
+                    "cached decode")
+            scan = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.n_layer,
+            )
+            x, _ = scan(cfg, name="blocks")(x, rope_tables, positions)
+        else:
+            for i in range(cfg.n_layer):
+                layer_cache = cache[i] if cache is not None else None
+                block = Qwen3Block(cfg, name=f"block_{i}")
+                if cfg.remat and cache is None:
+                    # gradient checkpointing (the reference fine-tunes all
+                    # call gradient_checkpointing_enable —
+                    # qwen3-8b-lora.py:128-144)
+                    x = layers.remat_apply(
+                        block, x, rope_tables, cache=None,
+                        positions=positions)
+                else:
+                    x, layer_cache = block(
+                        x, rope_tables, cache=layer_cache,
+                        positions=positions
+                    )
+                if new_caches is not None:
+                    new_caches.append(layer_cache)
         x = RMSNorm(cfg.rms_norm_eps, name="ln_f")(x)
         if return_hidden:
             return x

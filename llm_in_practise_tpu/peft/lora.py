@@ -53,12 +53,16 @@ class LoRAConfig:
 
 
 def target_paths(params, cfg: LoRAConfig) -> list[str]:
-    """All 2-D kernel paths matching any target pattern (regex or substring)."""
+    """Kernel paths matching any target pattern (regex or substring).
+
+    2-D kernels are the per-module case; 3-D kernels are the stacked
+    layouts — scan-over-layers models (leading ``n_layer`` axis) and
+    stacked MoE experts — which get per-slice factors."""
     pats = [re.compile(p) for p in cfg.target_patterns]
     out = []
     for path, leaf in jax.tree_util.tree_leaves_with_path(params):
         s = path_str(path)
-        if getattr(leaf, "ndim", 0) == 2 and any(p.search(s) for p in pats):
+        if getattr(leaf, "ndim", 0) in (2, 3) and any(p.search(s) for p in pats):
             out.append(s)
     return sorted(out)
 
@@ -76,11 +80,12 @@ def init_lora(
     by_path = flatten_with_paths(params)
     tree = {}
     for i, path in enumerate(paths):
-        d_in, d_out = by_path[path].shape
+        shape = by_path[path].shape
+        *stack, d_in, d_out = shape
         key = jax.random.fold_in(rng, i)
         tree[path] = {
-            "a": jax.random.normal(key, (d_in, cfg.r), dtype) * 0.02,
-            "b": jnp.zeros((cfg.r, d_out), dtype),
+            "a": jax.random.normal(key, (*stack, d_in, cfg.r), dtype) * 0.02,
+            "b": jnp.zeros((*stack, cfg.r, d_out), dtype),
         }
     return tree
 

@@ -19,18 +19,55 @@ from llm_in_practise_tpu.peft import lora as lora_lib
 from llm_in_practise_tpu.quant import nf4
 
 
+def _quant_predicate(path: str, leaf, min_size: int) -> bool:
+    """Which leaves NF4-quantize: Dense *kernels* (2-D, or 3-D stacked —
+    scan-over-layers models, stacked MoE experts) of ``min_size``+
+    elements. Restricting to ``.../kernel`` paths keeps norm scales out:
+    in the scan layout a stacked RMSNorm scale is 2-D and big enough to
+    pass a shape-only check, but norms must never be lossy-compressed
+    (bitsandbytes ignores them too). Embedding/lm_head stay bf16
+    (reference ``Quantization`` recipes ``ignore=["lm_head"]``)."""
+    if not path.endswith("/kernel"):
+        return False
+    if getattr(leaf, "ndim", 0) not in (2, 3) or leaf.size < min_size:
+        return False
+    return "embed" not in path and "lm_head" not in path
+
+
 def quantize_base(params, *, min_size: int = 4096):
-    """NF4-quantize every 2-D kernel of ``min_size``+ elements.
+    """NF4-quantize the Dense kernels (see :func:`_quant_predicate`)."""
+    return nf4.quantize_tree(
+        params, lambda p, leaf: _quant_predicate(p, leaf, min_size))
 
-    Embedding/lm_head-sized and tiny kernels stay bf16 (the reference keeps
-    lm_head unquantized too — ``Quantization`` recipes ``ignore=["lm_head"]``).
+
+def quantize_base_lowmem(params, *, min_size: int = 4096,
+                         cast_rest_above: int | None = 1_000_000):
+    """:func:`quantize_base` for multi-billion-param trees on one chip.
+
+    Quantizing the whole tree in a single jitted program keeps every
+    leaf's s32/f32 quantization temps live at once and OOMs HBM around
+    ~2B params; here each leaf runs as its own jitted call with the f32
+    input **donated**, so peak memory is the (shrinking) f32 tree plus
+    one leaf's temps. ``cast_rest_above``: non-quantized float32 leaves
+    bigger than this many elements (the embedding) drop to bf16 — they
+    are consumed in bf16 anyway and f32 residency wastes HBM.
     """
-    def predicate(path, leaf):
-        if getattr(leaf, "ndim", 0) != 2 or leaf.size < min_size:
-            return False
-        return "embed" not in path and "lm_head" not in path
+    from llm_in_practise_tpu.utils.tree import path_str
 
-    return nf4.quantize_tree(params, predicate)
+    q = jax.jit(nf4.quantize, donate_argnums=0)
+    cast = jax.jit(lambda v: v.astype(jnp.bfloat16), donate_argnums=0)
+
+    def maybe(path, leaf):
+        s = path_str(path)
+        if _quant_predicate(s, leaf, min_size):
+            return q(leaf)
+        if (cast_rest_above is not None
+                and getattr(leaf, "dtype", None) == jnp.float32
+                and leaf.size > cast_rest_above):
+            return cast(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe, params)
 
 
 def qlora_apply(qparams, lora_params, cfg: lora_lib.LoRAConfig,

@@ -136,12 +136,43 @@ def quantize(w: jax.Array | np.ndarray) -> NF4Tensor:
     if pad:
         flat = jnp.pad(flat, (0, pad))
     blocks = flat.reshape(-1, BLOCK)
-    absmax = jnp.max(jnp.abs(blocks), axis=1)                      # (nb,)
-    scaled = blocks / jnp.maximum(absmax, 1e-12)[:, None]
-    codes = _nearest_codes(scaled).reshape(-1)
-    packed = (codes[0::2] << 4) | codes[1::2]                      # (n_pad//2,)
+    packed, absmax = _quantize_blocks(blocks)
     absmax_q, s_scale, offset = _double_quant(absmax)
     return NF4Tensor(packed, absmax_q, s_scale, offset, shape, "flat")
+
+
+# Above this many 64-blocks the per-block pass runs as a lax.scan over
+# chunks: the one-shot form materializes an s32 code tensor (2 lanes of
+# padding on TPU) the size of the weight — several GB of transient HBM per
+# multi-hundred-M-param leaf, which OOMs next to the still-resident f32
+# tree during checkpoint quantization.
+_CHUNK_BLOCKS = 1 << 19
+
+
+def _quantize_blocks(blocks: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(packed bytes (nb*32,), absmax (nb,)) for ``(nb, 64)`` blocks —
+    chunked when large so transients stay bounded; numerics identical."""
+
+    def one(b):
+        absmax = jnp.max(jnp.abs(b), axis=1)
+        scaled = b / jnp.maximum(absmax, 1e-12)[:, None]
+        codes = _nearest_codes(scaled).reshape(-1)
+        return (codes[0::2] << 4) | codes[1::2], absmax
+
+    nb = blocks.shape[0]
+    if nb <= _CHUNK_BLOCKS:
+        return one(blocks)
+    # smallest chunk count that divides nb exactly (no padding: padding
+    # would perturb the double-quant mean); fall back to one shot if prime
+    target = -(-nb // _CHUNK_BLOCKS)
+    n_chunks = next((c for c in range(target, int(nb ** 0.5) + 1)
+                     if nb % c == 0), 1)
+    if n_chunks == 1:
+        return one(blocks)
+    _, (packed_c, absmax_c) = jax.lax.scan(
+        lambda _, b: (None, one(b)), None,
+        blocks.reshape(n_chunks, nb // n_chunks, BLOCK))
+    return packed_c.reshape(-1), absmax_c.reshape(-1)
 
 
 def kblock_arrays(t: NF4Tensor) -> tuple[jax.Array, jax.Array]:

@@ -75,7 +75,8 @@ def test_forward_shape_and_cache_parity():
 
 
 def test_hf_checkpoint_fidelity(hf_ckpt_dir):
-    model, params = hf_loader.load_qwen3(str(hf_ckpt_dir), dtype=jnp.float32)
+    model, params = hf_loader.load_qwen3(str(hf_ckpt_dir), dtype=jnp.float32,
+                                         config_overrides={"compute_dtype": "float32"})
     ids = np.load(hf_ckpt_dir / "ref_ids.npy")
     ref = np.load(hf_ckpt_dir / "ref_logits.npy")
     ours = model.apply({"params": params}, jnp.asarray(ids))
@@ -83,9 +84,11 @@ def test_hf_checkpoint_fidelity(hf_ckpt_dir):
 
 
 def test_hf_roundtrip_export(hf_ckpt_dir, tmp_path):
-    model, params = hf_loader.load_qwen3(str(hf_ckpt_dir), dtype=jnp.float32)
+    model, params = hf_loader.load_qwen3(str(hf_ckpt_dir), dtype=jnp.float32,
+                                         config_overrides={"compute_dtype": "float32"})
     hf_loader.save_qwen3(params, model.cfg, str(tmp_path / "export"))
-    model2, params2 = hf_loader.load_qwen3(str(tmp_path / "export"), dtype=jnp.float32)
+    model2, params2 = hf_loader.load_qwen3(str(tmp_path / "export"), dtype=jnp.float32,
+                                           config_overrides={"compute_dtype": "float32"})
     ids = jnp.asarray(np.load(hf_ckpt_dir / "ref_ids.npy"))
     a = model.apply({"params": params}, ids)
     b = model2.apply({"params": params2}, ids)
@@ -114,7 +117,9 @@ def test_tied_embeddings():
     tmodel = transformers.Qwen3ForCausalLM(cfg).eval().to(torch.float32)
     with tempfile.TemporaryDirectory() as d:
         tmodel.save_pretrained(d, safe_serialization=True)
-        model, params = hf_loader.load_qwen3(d, dtype=jnp.float32)
+        model, params = hf_loader.load_qwen3(
+            d, dtype=jnp.float32,
+            config_overrides={"compute_dtype": "float32"})
         assert model.cfg.tie_word_embeddings
         assert "lm_head" not in params
         ids = torch.arange(2, 18).remainder(tiny["vocab_size"]).reshape(2, 8)
@@ -138,7 +143,8 @@ def test_sharded_load_on_mesh(hf_ckpt_dir):
         return NamedSharding(mesh, P())
 
     model, params = hf_loader.load_qwen3(
-        str(hf_ckpt_dir), dtype=jnp.float32, sharding_fn=sharding_fn
+        str(hf_ckpt_dir), dtype=jnp.float32, sharding_fn=sharding_fn,
+        config_overrides={"compute_dtype": "float32"},
     )
     kern = params["block_0"]["mlp"]["gate_proj"]["kernel"]
     assert not kern.sharding.is_fully_replicated
