@@ -1,0 +1,19 @@
+"""mlops/feature_engineering demo: the ladder runs and its invariants
+(engineered beats raw; selection ~lossless) hold."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_feature_ladder_runs():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "mlops", "feature_engineering", "demo.py")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "feature ladder OK" in proc.stdout
