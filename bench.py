@@ -127,62 +127,72 @@ def check_mfu(name: str, mfu: float) -> None:
 def bench_qlora(peak: float) -> dict:
     from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
     from llm_in_practise_tpu.peft import lora as lora_lib
-    from llm_in_practise_tpu.peft.qlora import (
-        qlora_apply,
-        quantize_base_lowmem,
-    )
+    from llm_in_practise_tpu.peft.fused import make_fused_qlora_loss_fn
+    from llm_in_practise_tpu.peft.qlora import quantize_base_lowmem
     from llm_in_practise_tpu.train.losses import fused_linear_cross_entropy
 
     SEQ = 1024
     # Qwen3-1.7B-shaped (hidden 2048 / inter 6144 / 28 layers / GQA 16:8,
-    # vocab 151936, tied) — sized to fill one v5e chip's HBM as NF4 + remat.
-    # scan_layers is load-bearing: the unrolled 28-layer HLO takes >40 min
-    # through the AOT compile service; the scanned program compiles one
-    # block. The NF4 base dequantizes inside the jitted step (the Pallas
-    # fused kernel can't slice stacked scan weights per iteration).
-    # Smaller fallback if the compile service rejects the program.
+    # tied) with vocab 32768: measured on this chip's AOT compile service,
+    # the 151936-vocab head makes ANY step variant un-compilable (>25 min;
+    # scanned, unrolled, with or without remat), while the same program at
+    # 32k vocab compiles in ~4 min — so the bench trades vocab width for a
+    # compilable artifact and says so in the output. The forward runs the
+    # fused NF4 Pallas kernels (the bf16 base never exists in HBM).
+    # Depth fallback if the compile service still rejects the program.
     shapes = [
         dict(hidden_size=2048, intermediate_size=6144, n_layer=28,
              n_head=16, n_kv_head=8, head_dim=128),
-        dict(hidden_size=1536, intermediate_size=4608, n_layer=16,
-             n_head=12, n_kv_head=4, head_dim=128),
+        dict(hidden_size=2048, intermediate_size=6144, n_layer=12,
+             n_head=16, n_kv_head=8, head_dim=128),
     ]
     errors: list[str] = []
     for shape in shapes:
         try:
             cfg = Qwen3Config(
-                vocab_size=151936, max_seq_len=SEQ, rope_theta=1e6,
-                tie_word_embeddings=True, remat=True, scan_layers=True,
+                vocab_size=32768, max_seq_len=SEQ, rope_theta=1e6,
+                tie_word_embeddings=True, remat=True,
                 compute_dtype="bfloat16", **shape,
             )
             model = Qwen3(cfg)
-            params = jax.jit(
-                lambda r: model.init(r, jnp.ones((1, 8), jnp.int32))["params"]
+            # O(1)-in-depth init: unrolled init compiles superlinearly in
+            # depth (the 28-layer init alone took >40 min through the
+            # compile service), so ONE layer is initialized+quantized and
+            # its frozen NF4 subtree is shared across every block — valid
+            # for a throughput bench (identical per-layer compute; the
+            # trained LoRA factors stay per-layer distinct).
+            seed_params = jax.jit(
+                lambda r: Qwen3(cfg.replace(n_layer=1)).init(
+                    r, jnp.ones((1, 8), jnp.int32))["params"]
             )(jax.random.PRNGKey(0))
-            m = matmul_param_count(params, tied_head=True)
-            n_total = sum(x.size for x in jax.tree.leaves(params))
+            qseed = quantize_base_lowmem(seed_params)
+            del seed_params
+            qparams = {k: v for k, v in qseed.items() if k != "block_0"}
+            for i in range(cfg.n_layer):
+                qparams[f"block_{i}"] = qseed["block_0"]
+
+            abstract = jax.eval_shape(
+                lambda r: model.init(r, jnp.ones((1, 8), jnp.int32))["params"],
+                jax.random.PRNGKey(0))
+            m = matmul_param_count(abstract, tied_head=True)
+            n_total = sum(
+                int(np.prod(x.shape)) for x in jax.tree.leaves(abstract))
             lcfg = lora_lib.LoRAConfig(r=8, alpha=16.0,
                                        target_patterns=("q_proj", "v_proj"))
             lora = jax.jit(
-                lambda p: lora_lib.init_lora(p, lcfg, jax.random.PRNGKey(1))
-            )(params)
+                lambda: lora_lib.init_lora(abstract, lcfg,
+                                           jax.random.PRNGKey(1)))()
 
-            # per-leaf jitted quantize with donation: one whole-tree
-            # program OOMs HBM on multi-B trees, and eager ops would each
-            # be their own remote compile under the axon tunnel
-            qparams = quantize_base_lowmem(params)
-            del params  # only the NF4 tree stays resident
-
-            def loss_fn(lp, batch, rng):
-                eff = qlora_apply(qparams, lp, lcfg)
+            def base_loss(apply_out, batch, rng):
                 x, y = batch
-                hidden = model.apply({"params": eff}, x,
-                                     deterministic=True, return_hidden=True)
+                hidden = apply_out(x, return_hidden=True)
                 loss, _ = fused_linear_cross_entropy(
-                    hidden, eff["tok_embed"]["embedding"], y,
+                    hidden, qparams["tok_embed"]["embedding"], y,
                     transpose_weight=True, chunk=2048)
                 return loss
 
+            loss_fn = make_fused_qlora_loss_fn(model, qparams, lcfg,
+                                               base_loss)
             tx = optax.adamw(1e-4)
             opt_state = tx.init(lora)
 
@@ -196,7 +206,7 @@ def bench_qlora(peak: float) -> dict:
                                     cfg.n_head * cfg.head_dim,
                                     train_full=False)
             rng = np.random.default_rng(0)
-            for batch_size in (8, 4, 2):
+            for batch_size in (16, 8, 4):
                 try:
                     x = jnp.asarray(
                         rng.integers(0, cfg.vocab_size, (batch_size, SEQ)),
@@ -222,7 +232,8 @@ def bench_qlora(peak: float) -> dict:
                         "tokens_per_sec_per_chip": round(tok_s, 1),
                         "mfu": round(mfu, 4),
                         "model": f"qwen3-arch {n_total/1e9:.2f}B "
-                                 f"(L{cfg.n_layer}/d{cfg.hidden_size})",
+                                 f"(L{cfg.n_layer}/d{cfg.hidden_size}, "
+                                 f"vocab 32768 — see bench_qlora docstring)",
                         "batch": batch_size, "seq": SEQ,
                         "flops_per_token": f_tok,
                         "a100_est_tok_s": round(a100_est, 1),

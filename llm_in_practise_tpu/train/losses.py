@@ -41,6 +41,7 @@ def fused_linear_cross_entropy(
     bias: jax.Array | None = None,
     ignore_index: int = IGNORE_INDEX,
     chunk: int = 4096,
+    vocab_chunk: int | None = None,
     compute_dtype=jnp.bfloat16,
 ) -> tuple[jax.Array, jax.Array]:
     """LM-head projection fused into the loss — full logits never exist.
@@ -55,6 +56,13 @@ def fused_linear_cross_entropy(
     regardless of batch. Same role as the reference's fused CE in its CUDA
     stack (torch ``nn.CrossEntropyLoss`` over flattened logits,
     ``minigpt2/model.py:104``) but restructured for HBM, not translated.
+
+    ``vocab_chunk`` additionally tiles the VOCAB axis with a streaming
+    (online-softmax) logsumexp, so no single dot ever spans the full
+    vocabulary — both a memory bound (``chunk × vocab_chunk`` peak) and a
+    compiler bound: very wide heads (Qwen3's 151936) have been observed
+    to stall AOT TPU compilation when emitted as one dot. The actual
+    tile width is the nearest divisor of the vocab size.
 
     hidden: (..., dim); weight: (dim, vocab), or (vocab, dim) with
     ``transpose_weight=True`` (tied-embedding ``attend`` layout);
@@ -78,19 +86,78 @@ def fused_linear_cross_entropy(
 
     w = weight.astype(compute_dtype)
 
-    @jax.checkpoint
-    def chunk_nll(w, b, hc, lb):
+    vocab = weight.shape[0] if transpose_weight else weight.shape[1]
+    vocab_axis = 0 if transpose_weight else 1
+    n_vtiles = 1
+    if vocab_chunk is not None and vocab > vocab_chunk:
+        # smallest tile count that divides the vocab exactly (padding the
+        # weight would copy it) — but only within 4x of the requested
+        # granularity: a prime-ish vocab would otherwise "tile" at width
+        # 1 and turn the loss into thousands of MXU-hostile slivers.
+        # No acceptable divisor -> untiled.
+        target = -(-vocab // vocab_chunk)
+        n_vtiles = next(
+            (c for c in range(target, min(4 * target, vocab) + 1)
+             if vocab % c == 0), 1)
+    vtile = vocab // n_vtiles
+
+    def _tile_logits(w, b, hc, start):
+        wt = jax.lax.dynamic_slice_in_dim(w, start, vtile, axis=vocab_axis)
         contract = ((1,), (1,)) if transpose_weight else ((1,), (0,))
         logits = jax.lax.dot_general(
-            hc.astype(compute_dtype), w, (contract, ((), ())),
+            hc, wt, (contract, ((), ())),
             preferred_element_type=jnp.float32,
         )
         if b is not None:
-            logits = logits + b.astype(jnp.float32)
-        lse = jax.nn.logsumexp(logits, axis=-1)
+            logits = logits + jax.lax.dynamic_slice_in_dim(
+                b, start, vtile, axis=0).astype(jnp.float32)
+        return logits
+
+    @jax.checkpoint
+    def chunk_nll(w, b, hc, lb):
+        hc = hc.astype(compute_dtype)
         valid = lb != ignore_index
         safe = jnp.where(valid, lb, 0)
-        tgt = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        if n_vtiles == 1:
+            logits = _tile_logits(w, b, hc, 0)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+            return ((lse - tgt) * valid).sum(), valid.sum()
+
+        # streaming logsumexp over vocab tiles (online softmax): running
+        # (max, sumexp) per token plus the target logit picked from the
+        # tile that owns it — never a full-vocab dot
+        t = hc.shape[0]
+        init = (jnp.full((t,), -jnp.inf, jnp.float32),   # running max
+                jnp.zeros((t,), jnp.float32),            # running sumexp
+                jnp.zeros((t,), jnp.float32))            # target logit
+
+        # checkpointed per tile: without this, the inner scan's VJP stacks
+        # every tile's (chunk, vtile) logits residuals and peak backward
+        # memory is chunk x vocab again — the bound this tiling exists for
+        @jax.checkpoint
+        def tile_stats(w, b, hc, i):
+            logits = _tile_logits(w, b, hc, i * vtile)
+            tile_max = jnp.max(logits, axis=-1)
+            sumexp = jnp.exp(logits - tile_max[:, None]).sum(-1)
+            local = safe - i * vtile
+            in_tile = (local >= 0) & (local < vtile)
+            picked = jnp.take_along_axis(
+                logits, jnp.clip(local, 0, vtile - 1)[:, None], axis=1
+            )[:, 0]
+            return tile_max, sumexp, in_tile, picked
+
+        def vbody(carry, i):
+            m, s, tgt = carry
+            tile_max, sumexp, in_tile, picked = tile_stats(w, b, hc, i)
+            new_m = jnp.maximum(m, tile_max)
+            s = (s * jnp.exp(m - new_m)
+                 + sumexp * jnp.exp(tile_max - new_m))
+            tgt = jnp.where(in_tile, picked, tgt)
+            return (new_m, s, tgt), None
+
+        (m, s, tgt), _ = jax.lax.scan(vbody, init, jnp.arange(n_vtiles))
+        lse = m + jnp.log(s)
         return ((lse - tgt) * valid).sum(), valid.sum()
 
     def body(carry, xs):

@@ -43,7 +43,8 @@ def head_weight(params) -> tuple[jax.Array, bool, jax.Array | None]:
     return params["tok_embed"]["embedding"], True, None
 
 
-def make_fused_ce_loss(*, chunk: int = 4096, compute_dtype="bfloat16") -> Callable:
+def make_fused_ce_loss(*, chunk: int = 4096, vocab_chunk: int | None = None,
+                       compute_dtype="bfloat16") -> Callable:
     """Next-token loss with the LM-head projection fused into the CE
     (:func:`..train.losses.fused_linear_cross_entropy`) — the full
     ``(batch, seq, vocab)`` logits tensor never exists, so large-batch /
@@ -58,7 +59,8 @@ def make_fused_ce_loss(*, chunk: int = 4096, compute_dtype="bfloat16") -> Callab
         w, transpose, bias = head_weight(params)
         loss_val, n_valid = fused_linear_cross_entropy(
             hidden, w, y, transpose_weight=transpose, bias=bias,
-            chunk=chunk, compute_dtype=jnp.dtype(compute_dtype),
+            chunk=chunk, vocab_chunk=vocab_chunk,
+            compute_dtype=jnp.dtype(compute_dtype),
         )
         return loss_val, {"n_valid": n_valid}
 

@@ -25,9 +25,12 @@ def _naive(h, w, labels, transpose):
 
 @pytest.mark.parametrize("transpose", [False, True])
 @pytest.mark.parametrize("chunk", [7, 16, 1000])
-def test_fused_matches_naive_value_and_grad(transpose, chunk):
+@pytest.mark.parametrize("vocab_chunk", [None, 4, 10])
+def test_fused_matches_naive_value_and_grad(transpose, chunk, vocab_chunk):
     rng = np.random.default_rng(0)
-    T, D, V = 37, 16, 29  # deliberately non-divisible by every chunk size
+    # V=30: vocab_chunk=4 -> target 8 tiles -> divisor 10 -> tile width 3;
+    # vocab_chunk=10 -> 3 tiles of width 10. T non-divisible by every chunk.
+    T, D, V = 37, 16, 30
     h = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
     w = jnp.asarray(
         rng.normal(size=(V, D) if transpose else (D, V)), jnp.float32
@@ -38,7 +41,7 @@ def test_fused_matches_naive_value_and_grad(transpose, chunk):
     def fused(h, w):
         return fused_linear_cross_entropy(
             h, w, labels, transpose_weight=transpose, chunk=chunk,
-            compute_dtype=jnp.float32,
+            vocab_chunk=vocab_chunk, compute_dtype=jnp.float32,
         )[0]
 
     def naive(h, w):
@@ -83,8 +86,11 @@ def test_fused_ce_train_step_matches_naive_step(tied):
             model, params, optax.sgd(0.1), jax.random.PRNGKey(2))
 
     step_naive = make_train_step(donate=False)
+    # vocab_chunk exercises the streaming-lse path WITH a head bias
+    # (untied) and the tied embedding alike
     step_fused = make_train_step(
-        loss_fn=make_fused_ce_loss(chunk=16, compute_dtype="float32"),
+        loss_fn=make_fused_ce_loss(chunk=16, vocab_chunk=16,
+                                   compute_dtype="float32"),
         donate=False)
     s_n, m_n = step_naive(state(), batch)
     s_f, m_f = step_fused(state(), batch)
@@ -93,3 +99,19 @@ def test_fused_ce_train_step_matches_naive_step(tied):
     # parameters after the step must agree too (same gradients)
     for pn, pf in zip(jax.tree.leaves(s_n.params), jax.tree.leaves(s_f.params)):
         np.testing.assert_allclose(pf, pn, rtol=1e-4, atol=1e-6)
+
+
+def test_vocab_chunk_prime_vocab_falls_back_untiled():
+    """A prime vocab has no usable divisor near the requested tile width;
+    the loss must fall back to untiled rather than width-1 slivers."""
+    rng = np.random.default_rng(1)
+    T, D, V = 16, 8, 31  # prime
+    h = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, V)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (T,)), jnp.int32)
+    tiled = fused_linear_cross_entropy(
+        h, w, labels, vocab_chunk=8, compute_dtype=jnp.float32)[0]
+    ref = fused_linear_cross_entropy(
+        h, w, labels, compute_dtype=jnp.float32)[0]
+    np.testing.assert_allclose(np.asarray(tiled), np.asarray(ref),
+                               rtol=1e-6)
