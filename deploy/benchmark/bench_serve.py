@@ -87,7 +87,13 @@ def one_request(url, model, prompt, max_tokens, timeout):
                     stamps.append(now)
     except OSError:
         return False, None, [], 0
-    tpot = [b - a for a, b in zip(stamps, stamps[1:])]
+    # Per-request mean inter-token time, (last - first)/(n - 1) — the
+    # `vllm bench serve` TPOT definition. Raw per-gap sampling breaks
+    # under burst delivery (multi-step decode / speculative bursts emit
+    # several SSE events back-to-back: most gaps read ~0 and one gap
+    # reads a whole block, so per-gap percentiles are meaningless).
+    tpot = ([(stamps[-1] - stamps[0]) / (len(stamps) - 1)]
+            if len(stamps) > 1 else [])
     return ttft is not None, ttft, tpot, len(stamps)
 
 

@@ -67,6 +67,11 @@ def main():
                    help="ngram/prompt-lookup speculative decoding: draft K "
                         "tokens per step, verify in one forward (lossless "
                         "for greedy; vLLM ngram speculator parity)")
+    p.add_argument("--decode-steps", dest="decode_steps", type=int,
+                   default=1, metavar="N",
+                   help="decode N tokens per jitted dispatch (vLLM "
+                        "multi-step scheduling parity) — the lever when "
+                        "host dispatch latency rivals the decode step")
     p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype",
                    default="float32", choices=["float32", "bfloat16", "fp8"],
                    help="KV cache storage dtype; fp8 (e4m3) halves KV HBM "
@@ -149,6 +154,7 @@ def main():
         prefix_cache=args.prefix_caching,
         chunked_prefill=args.chunked_prefill, mesh=mesh,
         speculative_k=args.speculative,
+        decode_steps=args.decode_steps,
     )
     engine = InferenceEngine(model, params,
                              kv_pool=make_kv_pool(args.model_name),

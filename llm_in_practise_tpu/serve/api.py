@@ -249,6 +249,13 @@ class OpenAIServer:
                 "# TYPE llm_spec_tokens_accepted_total counter",
                 f"llm_spec_tokens_accepted_total {self.engine.spec_accepted}",
             ]
+        if getattr(self.engine, "decode_steps", 1) > 1:
+            # operators tuning --decode-steps need to see whether blocks
+            # actually run (the gate silently falls back to single-step)
+            lines += [
+                "# TYPE llm_multi_decode_blocks_total counter",
+                f"llm_multi_decode_blocks_total {self.engine.multi_blocks}",
+            ]
         return "\n".join(lines) + "\n"
 
     # --- HTTP plumbing -------------------------------------------------------

@@ -153,6 +153,7 @@ class InferenceEngine:
         kv_pool=None,
         speculative_k: int | None = None,
         speculative_ngram: int = 3,
+        decode_steps: int = 1,
     ):
         self.model = model
         self.params = params
@@ -249,8 +250,25 @@ class InferenceEngine:
         self.slot_hist: list[list[int] | None] = [None] * max_slots
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Multi-step decode (vLLM multi-step scheduling parity): run
+        # ``decode_steps`` decode iterations inside ONE jitted call
+        # (a lax.scan), paying host-dispatch overhead once per block.
+        # This is the lever when dispatch latency rivals step time —
+        # weak hosts, remote-tunnel setups; on a fast local host 1 is
+        # fine. Used only when the queue is empty and no prefill is in
+        # flight (a block delays admission by its length), and never
+        # combined with speculative decoding (spec already batches).
+        # Slots that finish mid-block waste their remaining rows; the
+        # freed slot's rows/index are reset on reuse by the insert path
+        # (the same contract the speculative burst relies on).
+        if decode_steps < 1:
+            raise ValueError(f"decode_steps must be >= 1, got {decode_steps}")
+        self.decode_steps = decode_steps
+        self.multi_blocks = 0
 
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
+        self._decode_multi = jax.jit(self._decode_multi_fn,
+                                     donate_argnums=(1,))
         self._decode_spec = jax.jit(self._decode_spec_fn, donate_argnums=(1,))
         self._rewind = jax.jit(self._rewind_fn, donate_argnums=(0,))
         self._prefill = jax.jit(self._prefill_fn)
@@ -295,6 +313,28 @@ class InferenceEngine:
             temperature=temperature, top_k=top_k, top_p=top_p, greedy=greedy,
         )
         return next_tok.astype(jnp.int32), cache
+
+    def _decode_multi_fn(self, params, cache, tokens, rng, temperature,
+                         top_k, top_p, greedy):
+        """``decode_steps`` single-token decodes under one lax.scan —
+        one compiled program, one dispatch. Returns ((B, n) tokens, cache)."""
+
+        def body(carry, key):
+            tok, cache = carry
+            logits, cache = self.model.apply(
+                {"params": params}, tok[:, None], deterministic=True,
+                cache=cache,
+            )
+            nxt = sample_token_batched(
+                key, logits[:, -1, :].astype(jnp.float32),
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                greedy=greedy,
+            ).astype(jnp.int32)
+            return (nxt, cache), nxt
+
+        keys = jax.random.split(rng, self.decode_steps)
+        (_, cache), toks = jax.lax.scan(body, (tokens, cache), keys)
+        return toks.T, cache                     # (B, n)
 
     def _decode_spec_fn(self, params, cache, tokens):
         """Verify step: tokens (B, K+1); returns greedy continuations at
@@ -702,14 +742,20 @@ class InferenceEngine:
             for j in range(n_acc + 1):
                 if self.slot_req[s] is None:
                     break                     # finished mid-burst (eos/len)
-                tok = int(out_host[s, j])
-                self.slot_budget[s] -= 1
-                self.slot_len[s] += 1
-                self.slot_last_token[s] = tok
-                self.slot_hist[s].append(tok)
-                self._emit(s, tok)
+                self._commit_token(s, int(out_host[s, j]))
         self.cache = self._rewind(self.cache, jnp.asarray(delta))
         return True
+
+    def _commit_token(self, slot: int, tok: int) -> None:
+        """Book one generated token into a slot: budget/length/last-token
+        tracking, spec history, and emission (which may finish the slot).
+        The single, speculative, and multi-step paths all commit here."""
+        self.slot_budget[slot] -= 1
+        self.slot_len[slot] += 1
+        self.slot_last_token[slot] = tok
+        if self.slot_hist[slot] is not None:
+            self.slot_hist[slot].append(tok)
+        self._emit(slot, tok)
 
     def step(self) -> bool:
         """One engine iteration. Returns False when fully idle."""
@@ -726,6 +772,44 @@ class InferenceEngine:
                         r is not None for r in self.slot_req)
                 return True
             self.rng, sub = jax.random.split(self.rng)
+            n = self.decode_steps
+            # a block delays admission by its length, so only run it when
+            # admission couldn't happen anyway: queue empty OR no free
+            # slot for a waiting request to land in
+            admission_possible = (
+                self.pending.qsize() > 0
+                and any(r is None for r in self.slot_req)
+            )
+            use_multi = (
+                n > 1
+                and self.speculative_k is None
+                and not admission_possible
+                and not self.slot_prefill
+                # every row the block writes must land inside the cache
+                and all(self.slot_len[s] + n <= self.cache_len
+                        for s in active)
+            )
+            if use_multi:
+                toks, self.cache = self._decode_multi(
+                    self.params, self.cache,
+                    jnp.asarray(self.slot_last_token),
+                    sub,
+                    jnp.asarray(self._temperature),
+                    jnp.asarray(self._top_k),
+                    jnp.asarray(self._top_p),
+                    jnp.asarray(self._greedy),
+                )
+                toks_host = np.asarray(toks)
+                self.multi_blocks += 1
+                for slot in active:
+                    for j in range(n):
+                        if self.slot_req[slot] is None:
+                            break             # finished mid-block (eos/len)
+                        self._commit_token(slot, int(toks_host[slot, j]))
+                with self.stats.lock:
+                    self.stats.active_slots = sum(
+                        r is not None for r in self.slot_req)
+                return True
             next_tok, self.cache = self._decode(
                 self.params, self.cache,
                 jnp.asarray(self.slot_last_token),
@@ -737,12 +821,7 @@ class InferenceEngine:
             )
             next_host = np.asarray(next_tok)
             for slot in active:
-                self.slot_budget[slot] -= 1
-                self.slot_len[slot] += 1  # the decode wrote one token's KV
-                self.slot_last_token[slot] = next_host[slot]
-                if self.slot_hist[slot] is not None:
-                    self.slot_hist[slot].append(int(next_host[slot]))
-                self._emit(slot, int(next_host[slot]))
+                self._commit_token(slot, int(next_host[slot]))
             with self.stats.lock:
                 self.stats.active_slots = sum(r is not None for r in self.slot_req)
             return True

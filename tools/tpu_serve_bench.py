@@ -61,9 +61,11 @@ def main() -> None:
     model = GPT(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.ones((1, 8), jnp.int32))["params"]
+    decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
     engine = InferenceEngine(
         model, params, max_slots=16, cache_len=1024,
         chunked_prefill=256, speculative_k=None,
+        decode_steps=decode_steps,
     )
     srv = OpenAIServer(engine, ByteTokenizer(), model_name="gptlike-tpu")
     port = srv.serve(host="127.0.0.1", port=0, background=True)
@@ -92,7 +94,8 @@ def main() -> None:
         "device": jax.devices()[0].device_kind,
         "model": "GPTLike 6L/512d bf16 (~36M params) — NOT 8B; see header",
         "engine": {"max_slots": 16, "cache_len": 1024,
-                   "chunked_prefill": 256},
+                   "chunked_prefill": 256,
+                   "decode_steps": decode_steps},
         "requests_per_level": REQUESTS_PER_LEVEL,
         "max_tokens": MAX_TOKENS,
         "sla": {"ttft_p99_ms": 2000.0, "tpot_p99_ms": 100.0},
