@@ -206,7 +206,11 @@ def bench_qlora(peak: float) -> dict:
                                     cfg.n_head * cfg.head_dim,
                                     train_full=False)
             rng = np.random.default_rng(0)
-            for batch_size in (16, 8, 4):
+            # batch 8 saturates this config (16 was measured no faster
+            # before the compile service started rejecting it); a failed
+            # rung costs the driver minutes of compile, so the ladder
+            # starts at the proven point
+            for batch_size in (8, 4):
                 try:
                     x = jnp.asarray(
                         rng.integers(0, cfg.vocab_size, (batch_size, SEQ)),

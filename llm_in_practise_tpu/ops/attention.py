@@ -106,13 +106,21 @@ def dot_product_attention(
     """Attention entry point used by every model in the framework."""
     if impl == "auto":
         impl = _pick_impl(q, k, bias, kv_length, dropout_rate, causal)
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
+        # sequence-parallel schemes share one eligibility contract: full
+        # (uncached) self-attention under an active sp_context mesh
         from llm_in_practise_tpu.ops import ring_attention as ra
 
         if (bias is None and kv_length is None and dropout_rate == 0.0
                 and q_offset is None and k.shape[1] == q.shape[1]
                 and ra.active_sp_mesh() is not None):
-            return ra.context_ring_attention(q, k, v, causal=causal, scale=scale)
+            if impl == "ring":
+                return ra.context_ring_attention(
+                    q, k, v, causal=causal, scale=scale)
+            from llm_in_practise_tpu.ops import ulysses as ul
+
+            return ul.context_ulysses_attention(
+                q, k, v, causal=causal, scale=scale)
         impl = "dense"  # decode/cached paths fall back (KV not seq-sharded)
     if impl == "flash":
         from llm_in_practise_tpu.ops import flash_attention as fa

@@ -291,10 +291,12 @@ def zero_offload(devices: int = -1) -> Strategy:
 
 
 def sequence_parallel(seq: int = 2, fsdp_size: int = 1, data: int = -1) -> Strategy:
-    """Sequence/context parallelism over the ``seq`` axis via ring attention —
-    beyond the reference (absent there, SURVEY §5.7). Activations are sharded
-    ``(batch over data×fsdp, sequence over seq)``; models must set
-    ``attn_impl='ring'`` and run under
+    """Sequence/context parallelism over the ``seq`` axis — beyond the
+    reference (absent there, SURVEY §5.7). Activations are sharded
+    ``(batch over data×fsdp, sequence over seq)``; models pick the scheme
+    with ``attn_impl='ring'`` (ppermute ring, any head count) or
+    ``attn_impl='ulysses'`` (two all-to-alls, plain local attention,
+    degree capped by kv-head divisibility) and run under
     :class:`llm_in_practise_tpu.ops.ring_attention.sp_context`."""
     return Strategy(
         "sp",
