@@ -166,7 +166,22 @@ def _pick_impl(q, k, bias, kv_length, dropout_rate, causal=True) -> str:
         or k.shape != q.shape
     ):
         return "dense"
-    _, q_len, _, head_dim = q.shape
+    batch, q_len, n_head, head_dim = q.shape
+    # Measured on one v5e chip (GPTLike 6L/512d training step): XLA's
+    # fused dense attention beats the Pallas kernel on short sequences —
+    # 357K vs 253K tok/s at L=256, +23% at L=512 — the kernel's tiling
+    # overhead dominates small (L, L) score blocks. The flip side is the
+    # dense path's f32 score materialization, B·H·L² bytes ×2 held for
+    # the backward: at L=1024 training batches it no longer compiles.
+    # Gate dense on BOTH the measured length crossover (the 512..1K
+    # region is unmeasured — 512 is the last point dense provably wins)
+    # and an absolute score-memory bound so wide-and-batchy shapes at
+    # L<=512 don't trade the kernel's O(L) memory for an HBM blowup.
+    score_bytes = 4 * batch * n_head * q_len * q_len
+    # 2 GiB inclusive: the measured dense win at L=512/B=256/H=8 sits
+    # exactly at the bound (and compiled + ran), so it stays admitted
+    if q_len <= 512 and score_bytes <= (1 << 31):
+        return "dense"
     if q_len % 128 == 0 and head_dim in (64, 128, 256):
         return "flash"
     return "dense"
