@@ -127,8 +127,10 @@ def check_mfu(name: str, mfu: float) -> None:
 def bench_qlora(peak: float) -> dict:
     from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
     from llm_in_practise_tpu.peft import lora as lora_lib
-    from llm_in_practise_tpu.peft.fused import make_fused_qlora_loss_fn
-    from llm_in_practise_tpu.peft.qlora import quantize_base_lowmem
+    from llm_in_practise_tpu.peft.qlora import (
+        make_qlora_loss_fn,
+        quantize_base_lowmem,
+    )
     from llm_in_practise_tpu.train.losses import fused_linear_cross_entropy
 
     SEQ = 1024
@@ -138,8 +140,11 @@ def bench_qlora(peak: float) -> dict:
     # scanned, unrolled, with or without remat), while the same program at
     # 32k vocab compiles in ~4 min — so the bench trades vocab width for a
     # compilable artifact and says so in the output. The forward runs the
-    # fused NF4 Pallas kernels (the bf16 base never exists in HBM).
-    # Depth fallback if the compile service still rejects the program.
+    # XLA dequant path (qlora_apply): at training token counts it measures
+    # 77% faster than the fused NF4 Pallas kernel (11.3K vs 6.4K tok/s —
+    # XLA's matmuls win once activations are wide; the fused kernel is the
+    # serving/decode path where thin activations make weight traffic
+    # dominant). Depth fallback if the compile service rejects the program.
     shapes = [
         dict(hidden_size=2048, intermediate_size=6144, n_layer=28,
              n_head=16, n_kv_head=8, head_dim=128),
@@ -183,16 +188,16 @@ def bench_qlora(peak: float) -> dict:
                 lambda: lora_lib.init_lora(abstract, lcfg,
                                            jax.random.PRNGKey(1)))()
 
-            def base_loss(apply_out, batch, rng):
+            def base_loss(params, batch, rng):
                 x, y = batch
-                hidden = apply_out(x, return_hidden=True)
+                hidden = model.apply({"params": params}, x,
+                                     deterministic=True, return_hidden=True)
                 loss, _ = fused_linear_cross_entropy(
-                    hidden, qparams["tok_embed"]["embedding"], y,
+                    hidden, params["tok_embed"]["embedding"], y,
                     transpose_weight=True, chunk=2048)
                 return loss
 
-            loss_fn = make_fused_qlora_loss_fn(model, qparams, lcfg,
-                                               base_loss)
+            loss_fn = make_qlora_loss_fn(qparams, lcfg, base_loss)
             tx = optax.adamw(1e-4)
             opt_state = tx.init(lora)
 
