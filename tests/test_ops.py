@@ -156,3 +156,29 @@ class TestDebug:
         # traps off again: the same expression just yields nan
         out = jnp.log(jnp.zeros(4)) - jnp.log(jnp.zeros(4))
         assert bool(jnp.isnan(out).all())
+
+
+def test_attention_impl_crossover_heuristic(monkeypatch):
+    """The measured dense-vs-flash auto-pick (docs/perf.md finding 3):
+    dense for short sequences within the score-memory bound, flash for
+    long sequences; decode/cached shapes stay dense regardless."""
+    from llm_in_practise_tpu.ops import attention as A
+
+    monkeypatch.setattr(A, "_on_tpu", lambda: True)
+    monkeypatch.setattr(A, "_flash_available", lambda: True)
+
+    class Q:
+        def __init__(self, shape):
+            self.shape = shape
+
+    def pick(b, l, h, d, k_shape=None):
+        q = Q((b, l, h, d))
+        k = Q(k_shape) if k_shape else q
+        return A._pick_impl(q, k, None, None, 0.0)
+
+    assert pick(512, 256, 8, 64) == "dense"     # the bench rung
+    assert pick(256, 512, 8, 64) == "dense"     # measured dense win (2 GiB)
+    assert pick(128, 1024, 8, 64) == "flash"    # dense OOMs here
+    assert pick(512, 512, 32, 64) == "flash"    # over the score bound
+    # decode: cached KV longer than queries -> dense path regardless
+    assert pick(8, 1, 8, 64, k_shape=(8, 512, 8, 64)) == "dense"
