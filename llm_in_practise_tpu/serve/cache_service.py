@@ -202,6 +202,10 @@ class RemoteResponseCache:
         self.hits = 0
         self.semantic_hits = 0
         self.misses = 0
+        # get() calls that never reached the service (cooldown window or
+        # transport error). Kept out of `misses` so the gateway's hit-rate
+        # metric doesn't conflate outage time with genuine cache misses.
+        self.skipped = 0
         self._down_until = 0.0
         self._clock = clock
 
@@ -225,7 +229,12 @@ class RemoteResponseCache:
         if body.get("stream"):
             return None
         reply = self._post("/cache/get", body)
-        if reply and reply.get("found"):
+        if reply is None:
+            # Cooldown short-circuit or transport failure — the service
+            # never answered, so this is not a cache miss.
+            self.skipped += 1
+            return None
+        if reply.get("found"):
             self.hits += 1
             return reply["response"]
         self.misses += 1

@@ -280,24 +280,31 @@ class ResponseCache:
             return None
         now = time.time()
         key = self._key(body)
+        # Exact tier first, under the lock: an exact hit must never pay for
+        # (or wait behind) an embedding call — with a remote embed_fn a slow
+        # embedding backend would otherwise serialize every get/put here.
         with self._lock:
             hit = self._exact.get(key)
             if hit and now - hit[0] < self.ttl_s:
                 self.hits += 1
                 return hit[1]
-            if self.semantic_threshold is not None:
-                query = self._embed(self._conversation_text(body))
-                model = body.get("model")
-                best, best_sim = None, 0.0
-                for ts, m, emb, resp in self._semantic:
-                    if m != model or now - ts >= self.ttl_s:
-                        continue
-                    sim = sum(a * b for a, b in zip(query, emb))
-                    if sim > best_sim:
-                        best, best_sim = resp, sim
-                if best is not None and best_sim >= self.semantic_threshold:
-                    self.semantic_hits += 1
-                    return best
+            if self.semantic_threshold is None:
+                self.misses += 1
+                return None
+        # Embed OUTSIDE the lock (may be a remote /v1/embeddings call).
+        query = self._embed(self._conversation_text(body))
+        with self._lock:
+            model = body.get("model")
+            best, best_sim = None, 0.0
+            for ts, m, emb, resp in self._semantic:
+                if m != model or now - ts >= self.ttl_s:
+                    continue
+                sim = sum(a * b for a, b in zip(query, emb))
+                if sim > best_sim:
+                    best, best_sim = resp, sim
+            if best is not None and best_sim >= self.semantic_threshold:
+                self.semantic_hits += 1
+                return best
             self.misses += 1
             return None
 
@@ -305,16 +312,17 @@ class ResponseCache:
         if body.get("stream"):
             return
         now = time.time()
+        key = self._key(body)
+        # Embed before taking the lock — see get() for why.
+        emb = (self._embed(self._conversation_text(body))
+               if self.semantic_threshold is not None else None)
         with self._lock:
-            self._exact[self._key(body)] = (now, response)
+            self._exact[key] = (now, response)
             if len(self._exact) > self.max_entries:
                 oldest = min(self._exact, key=lambda k: self._exact[k][0])
                 del self._exact[oldest]
-            if self.semantic_threshold is not None:
-                self._semantic.append(
-                    (now, body.get("model"),
-                     self._embed(self._conversation_text(body)), response)
-                )
+            if emb is not None:
+                self._semantic.append((now, body.get("model"), emb, response))
                 if len(self._semantic) > self.max_entries:
                     self._semantic.pop(0)
 
@@ -531,6 +539,15 @@ class Gateway:
                 "# TYPE gateway_cache_misses_total counter",
                 f"gateway_cache_misses_total {self.cache.misses}",
             ]
+            # remote caches additionally track lookups that never reached
+            # the service (cooldown/transport) — without this line an
+            # outage reads as zero cache traffic instead of degraded
+            skipped = getattr(self.cache, "skipped", None)
+            if skipped is not None:
+                lines += [
+                    "# TYPE gateway_cache_skipped_total counter",
+                    f"gateway_cache_skipped_total {skipped}",
+                ]
         now = time.time()
         for u in self.router.upstreams:
             label = f'{{group="{u.group}",url="{u.base_url}"}}'
