@@ -154,6 +154,7 @@ class InferenceEngine:
         speculative_k: int | None = None,
         speculative_ngram: int = 3,
         decode_steps: int = 1,
+        prefill_budget: int = 1,
     ):
         self.model = model
         self.params = params
@@ -265,16 +266,30 @@ class InferenceEngine:
             raise ValueError(f"decode_steps must be >= 1, got {decode_steps}")
         self.decode_steps = decode_steps
         self.multi_blocks = 0
+        self.multi_steps_total = 0  # decode iterations spent inside blocks
+        # Guaranteed chunked-prefill budget: every engine step runs up to
+        # this many prefill chunks BEFORE any decode work, so decode load
+        # can never starve a prompt that is mid-prefill (the TTFT-fairness
+        # guarantee chunked prefill exists for — vLLM enable_chunked_prefill,
+        # Deployment/Ray/serve_run_examples/deepseek.py:32-35).
+        if prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1, got {prefill_budget}"
+            )
+        self.prefill_budget = prefill_budget
 
         self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
         self._decode_multi = jax.jit(self._decode_multi_fn,
-                                     donate_argnums=(1,))
+                                     donate_argnums=(1,),
+                                     static_argnames=("n",))
         self._decode_spec = jax.jit(self._decode_spec_fn, donate_argnums=(1,))
         self._rewind = jax.jit(self._rewind_fn, donate_argnums=(0,))
         self._prefill = jax.jit(self._prefill_fn)
         self._prefill_suffix = jax.jit(self._prefill_suffix_fn)
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,),
                                static_argnames=("slot",))
+        self._insert_batch = jax.jit(self._insert_batch_fn,
+                                     donate_argnums=(0,))
         self._insert_rows = jax.jit(self._insert_rows_fn, donate_argnums=(0,),
                                     static_argnames=("slot",))
         self._prime = jax.jit(self._prime_fn)
@@ -315,9 +330,11 @@ class InferenceEngine:
         return next_tok.astype(jnp.int32), cache
 
     def _decode_multi_fn(self, params, cache, tokens, rng, temperature,
-                         top_k, top_p, greedy):
-        """``decode_steps`` single-token decodes under one lax.scan —
-        one compiled program, one dispatch. Returns ((B, n) tokens, cache)."""
+                         top_k, top_p, greedy, *, n):
+        """``n`` single-token decodes under one lax.scan — one compiled
+        program, one dispatch. Returns ((B, n) tokens, cache). ``n`` is
+        static (≤ ``decode_steps`` distinct compilations): blocks shrink
+        when a slot is about to finish and requests are waiting."""
 
         def body(carry, key):
             tok, cache = carry
@@ -332,7 +349,7 @@ class InferenceEngine:
             ).astype(jnp.int32)
             return (nxt, cache), nxt
 
-        keys = jax.random.split(rng, self.decode_steps)
+        keys = jax.random.split(rng, n)
         (_, cache), toks = jax.lax.scan(body, (tokens, cache), keys)
         return toks.T, cache                     # (B, n)
 
@@ -353,13 +370,21 @@ class InferenceEngine:
         return [dict(layer, index=layer["index"] - delta) for layer in cache]
 
     def _prefill_fn(self, params, prompt_ids, length):
-        """prompt_ids: (1, bucket). Returns (last-valid logits, cache rows)."""
-        cache = self.model.init_cache(1, self.cache_len, dtype=self.cache_dtype)
+        """prompt_ids: (B, bucket), length: (B,). Returns per-request
+        last-valid logits (B, vocab) and a B-row, BUCKET-length prefill
+        cache (only bucket rows are ever written — allocating B x
+        cache_len here would transiently rival the whole engine cache at
+        a saturated admission burst). B > 1 = batched admission: several
+        same-bucket prompts prefill in ONE dispatch (vLLM batches waiting
+        prefills the same way; on TPU the batch dim also feeds the MXU
+        properly for short prompts)."""
+        B, bucket = prompt_ids.shape
+        cache = self.model.init_cache(B, bucket, dtype=self.cache_dtype)
         logits, cache = self.model.apply(
             {"params": params}, prompt_ids, deterministic=True, cache=cache
         )
         last = jnp.take_along_axis(
-            logits, (length - 1)[None, None, None], axis=1
+            logits, (length - 1)[:, None, None], axis=1
         )[:, 0, :]
         return last, cache
 
@@ -419,7 +444,9 @@ class InferenceEngine:
         return last, fixed
 
     def _insert_fn(self, engine_cache, prefill_cache, slot: int, length):
-        """Copy a prefilled request's cache rows into ``slot``."""
+        """Copy a prefilled request's cache rows into ``slot``. The
+        prefill cache may be bucket-length (one-shot path) or full-length
+        (suffix/chunked paths); only its width is written."""
         new = []
         for eng, pre in zip(engine_cache, prefill_cache):
             layer = {}
@@ -427,7 +454,26 @@ class InferenceEngine:
                 if key == "index":
                     layer["index"] = eng["index"].at[slot].set(length)
                 else:
-                    layer[key] = eng[key].at[slot].set(pre[key][0])
+                    width = pre[key].shape[1]
+                    layer[key] = eng[key].at[slot, :width].set(pre[key][0])
+            new.append(layer)
+        return new
+
+    def _insert_batch_fn(self, engine_cache, pre_cache, slot_ids, lengths):
+        """Scatter a B-row bucket-length prefill cache into B slots at
+        once. ``slot_ids`` is a traced (B,) vector, so one compilation
+        serves every slot combination of a given batch size."""
+        new = []
+        for eng, pre in zip(engine_cache, pre_cache):
+            layer = {}
+            for key in eng:
+                if key == "index":
+                    layer["index"] = eng["index"].at[slot_ids].set(lengths)
+                else:
+                    width = pre[key].shape[1]
+                    layer[key] = eng[key].at[slot_ids, :width].set(
+                        pre[key].astype(eng[key].dtype)
+                    )
             new.append(layer)
         return new
 
@@ -470,8 +516,13 @@ class InferenceEngine:
         return self.cache_len
 
     def _admit(self) -> bool:
-        """Move pending requests into free slots (prefill + insert)."""
+        """Move pending requests into free slots. Plain one-shot prefills
+        (no prefix hit, no chunking) are collected and run as BATCHED
+        dispatches; prefix hits and chunked prompts take their own paths."""
         admitted = False
+        batch: list[tuple[int, Request, int]] = []
+        deferred: list[tuple[int, Request, int]] = []
+        seen: set[tuple[int, ...]] = set()
         for slot in range(self.max_slots):
             if self.slot_req[slot] is not None:
                 continue
@@ -480,12 +531,81 @@ class InferenceEngine:
             except queue.Empty:
                 break
             plen = len(req.prompt_ids)
-            self._begin_prefill(req, slot, plen)
+            hit = self._lookup_prefix(req, plen)
+            if hit is None and not self._should_chunk(0, plen):
+                self.slot_req[slot] = req   # reserve; activated post-batch
+                self.slot_ready[slot] = False
+                cacheable = (self.prefix_cache is not None
+                             and plen >= self.prefix_cache.min_prefix)
+                if cacheable and tuple(req.prompt_ids) in seen:
+                    # duplicate of a prompt prefilling THIS burst: after
+                    # the batch stores its prefix entry this becomes a
+                    # full-prefix hit — keep the sequential path's
+                    # intra-burst reuse instead of prefilling it again
+                    deferred.append((slot, req, plen))
+                else:
+                    if cacheable:
+                        seen.add(tuple(req.prompt_ids))
+                    batch.append((slot, req, plen))
+            else:
+                self._begin_prefill(req, slot, plen, hit=hit)
             admitted = True
+        if batch:
+            self._prefill_batch(batch)
+        for slot, req, plen in deferred:
+            self._begin_prefill(req, slot, plen)  # fresh lookup: now a hit
         with self.stats.lock:
             self.stats.queue_depth = self.pending.qsize()
             self.stats.active_slots = sum(r is not None for r in self.slot_req)
         return admitted
+
+    def _prefill_batch(self, batch: list[tuple[int, "Request", int]]) -> None:
+        """One-shot prefill for several admitted requests in as few
+        dispatches as possible: group by bucket, split each group into
+        power-of-two sub-batches (compiled variants bounded at
+        log2(max_slots) per bucket), sample every first token in ONE
+        batched call."""
+        by_bucket: dict[int, list[tuple[int, Request, int]]] = {}
+        for slot, req, plen in batch:
+            by_bucket.setdefault(self._bucket_for(plen), []).append(
+                (slot, req, plen))
+        for bucket, group in by_bucket.items():
+            i = 0
+            while i < len(group):
+                size = 1 << ((len(group) - i).bit_length() - 1)
+                part = group[i:i + size]
+                i += size
+                ids = np.zeros((size, bucket), np.int32)
+                lens = np.zeros((size,), np.int32)
+                for j, (_, req, plen) in enumerate(part):
+                    ids[j, :plen] = req.prompt_ids
+                    lens[j] = plen
+                last, pre = self._prefill(
+                    self.params, jnp.asarray(ids), jnp.asarray(lens))
+                slot_ids = np.array([p[0] for p in part], np.int32)
+                self.cache = self._insert_batch(
+                    self.cache, pre, jnp.asarray(slot_ids),
+                    jnp.asarray(lens))
+                self.rng, sub = jax.random.split(self.rng)
+                first = np.asarray(sample_token_batched(
+                    sub, last.astype(jnp.float32),
+                    temperature=jnp.asarray(
+                        [r.params.temperature for _, r, _ in part],
+                        jnp.float32),
+                    top_k=jnp.asarray(
+                        [r.params.top_k for _, r, _ in part], jnp.int32),
+                    top_p=jnp.asarray(
+                        [r.params.top_p for _, r, _ in part], jnp.float32),
+                    greedy=jnp.asarray(
+                        [r.params.greedy for _, r, _ in part], bool),
+                ))
+                for j, (slot, req, plen) in enumerate(part):
+                    self._store_prefix(
+                        req, plen,
+                        [{k: v[j:j + 1] for k, v in layer.items()
+                          if k != "index"} for layer in pre],
+                        last[j:j + 1])
+                    self._activate_with_token(slot, req, plen, int(first[j]))
 
     def _activate(self, slot: int, req: Request, plen: int, last_logits):
         """Slot bookkeeping once the prompt's KV is in place; samples the
@@ -498,9 +618,11 @@ class InferenceEngine:
             top_p=jnp.asarray([req.params.top_p], jnp.float32),
             greedy=jnp.asarray([req.params.greedy], bool),
         )
-        first_id = int(first[0])
-        req.first_token_time = time.monotonic()
+        self._activate_with_token(slot, req, plen, int(first[0]))
 
+    def _activate_with_token(self, slot: int, req: Request, plen: int,
+                             first_id: int):
+        req.first_token_time = time.monotonic()
         self.slot_req[slot] = req
         self.slot_ready[slot] = True
         self.slot_last_token[slot] = first_id
@@ -524,6 +646,14 @@ class InferenceEngine:
     def _chunked_fits(self, done: int, rem: int) -> bool:
         return (self.chunked_prefill is not None
                 and done + self._chunk_span(rem) <= self.cache_len)
+
+    def _should_chunk(self, done: int, rem: int) -> bool:
+        """Chunk when the remainder is long (the point of interleaving) OR
+        when only the chunk span fits the cache. Single source of truth
+        for both admission paths (_admit and _begin_prefill)."""
+        return self._chunked_fits(done, rem) and (
+            rem > self.chunked_prefill or not self._oneshot_fits(done, rem)
+        )
 
     def _lookup_prefix(self, req: Request, plen: int):
         def usable(entry) -> bool:
@@ -556,11 +686,16 @@ class InferenceEngine:
         self.prefix_cache.put(req.prompt_ids[: hit.length], hit)
         return hit
 
-    def _begin_prefill(self, req: Request, slot: int, plen: int) -> None:
+    _UNSET = object()
+
+    def _begin_prefill(self, req: Request, slot: int, plen: int,
+                       hit=_UNSET) -> None:
         """Route one admitted request: full prefix hit → direct insert;
         long remainder (chunked prefill on) → incremental, one chunk per
-        engine step so running slots keep decoding; otherwise one-shot."""
-        hit = self._lookup_prefix(req, plen)
+        engine step so running slots keep decoding; otherwise one-shot.
+        ``hit`` may be passed by ``_admit`` (which already looked it up)."""
+        if hit is self._UNSET:
+            hit = self._lookup_prefix(req, plen)
         if hit is not None and hit.length == plen:
             self.cache = self._insert_rows(
                 self.cache, hit.rows, slot, jnp.asarray(plen, jnp.int32))
@@ -568,13 +703,9 @@ class InferenceEngine:
             return
         done = hit.length if hit is not None else 0
         rem = plen - done
-        # chunked when the remainder is long (the point of interleaving) OR
-        # when only the chunk span fits the cache; a hit that fits neither
-        # way was already filtered by _lookup_prefix's usable().
-        chunk_it = self._chunked_fits(done, rem) and (
-            rem > self.chunked_prefill or not self._oneshot_fits(done, rem)
-        )
-        if chunk_it:
+        # a hit that fits neither way was already filtered by
+        # _lookup_prefix's usable()
+        if self._should_chunk(done, rem):
             mini = (
                 self._prime(hit.rows, jnp.asarray(done, jnp.int32))
                 if hit is not None
@@ -590,48 +721,61 @@ class InferenceEngine:
         self._activate(slot, req, plen, last_logits)
 
     def _advance_prefills(self, budget: int = 1) -> bool:
-        """Run up to ``budget`` prefill chunks; finalize finished prompts."""
+        """Run up to ``budget`` prefill chunks; finalize finished prompts.
+        The budget is spent wherever there is work: with fewer mid-prefill
+        slots than budget, one slot gets several chunks this step (so
+        ``prefill_budget`` really bounds TTFT at ~chunks/budget steps even
+        for a single long prompt)."""
         progressed = False
-        for slot in list(self.slot_prefill):
-            if budget <= 0:
-                break
-            st = self.slot_prefill[slot]
-            req, plen = st["req"], st["plen"]
-            chunk = req.prompt_ids[st["done"]: st["done"] + self.chunked_prefill]
-            padded = np.zeros((1, self.chunked_prefill), np.int32)
-            padded[0, :len(chunk)] = chunk
-            st["last_logits"], st["cache"] = self._chunk(
-                self.params, st["cache"], jnp.asarray(padded),
-                jnp.asarray(len(chunk), jnp.int32),
-            )
-            st["done"] += len(chunk)
-            budget -= 1
-            progressed = True
-            if st["done"] >= plen:
-                del self.slot_prefill[slot]
-                self._finish_prefill(req, slot, plen, st["cache"],
-                                     st["last_logits"])
-                self._activate(slot, req, plen, st["last_logits"])
+        while budget > 0 and self.slot_prefill:
+            for slot in list(self.slot_prefill):
+                if budget <= 0:
+                    break
+                st = self.slot_prefill[slot]
+                req, plen = st["req"], st["plen"]
+                chunk = req.prompt_ids[
+                    st["done"]: st["done"] + self.chunked_prefill]
+                padded = np.zeros((1, self.chunked_prefill), np.int32)
+                padded[0, :len(chunk)] = chunk
+                st["last_logits"], st["cache"] = self._chunk(
+                    self.params, st["cache"], jnp.asarray(padded),
+                    jnp.asarray(len(chunk), jnp.int32),
+                )
+                st["done"] += len(chunk)
+                budget -= 1
+                progressed = True
+                if st["done"] >= plen:
+                    del self.slot_prefill[slot]
+                    self._finish_prefill(req, slot, plen, st["cache"],
+                                         st["last_logits"])
+                    self._activate(slot, req, plen, st["last_logits"])
         return progressed
+
+    def _store_prefix(self, req: Request, plen: int, pre_cache,
+                      last_logits) -> None:
+        """Store a finished prompt's prefix entry (L1 + optional pool
+        write-through). ``pre_cache`` must be a 1-row cache/rows list."""
+        from llm_in_practise_tpu.serve import prefix_cache as pc
+
+        if self.prefix_cache is None:
+            return
+        bucket = self._bucket_for(plen)
+        entry = pc.PrefixEntry(
+            length=plen, bucket=bucket,
+            rows=pc.slice_cache_rows(pre_cache, bucket),
+            last_logits=last_logits,
+        )
+        self.prefix_cache.put(req.prompt_ids, entry)
+        if self.kv_pool is not None and self.kv_pool.offload_on_put:
+            # LMCache streaming write-through: the pool copy means a
+            # sibling / restarted engine starts with this prefix warm.
+            self.kv_pool.offload(req.prompt_ids[:plen], entry)
 
     def _finish_prefill(self, req: Request, slot: int, plen: int,
                         pre_cache, last_logits) -> None:
         """Store the finished prompt's prefix entry and move its KV rows
-        into the slot — shared tail of both prefill paths."""
-        from llm_in_practise_tpu.serve import prefix_cache as pc
-
-        if self.prefix_cache is not None:
-            bucket = self._bucket_for(plen)
-            entry = pc.PrefixEntry(
-                length=plen, bucket=bucket,
-                rows=pc.slice_cache_rows(pre_cache, bucket),
-                last_logits=last_logits,
-            )
-            self.prefix_cache.put(req.prompt_ids, entry)
-            if self.kv_pool is not None and self.kv_pool.offload_on_put:
-                # LMCache streaming write-through: the pool copy means a
-                # sibling / restarted engine starts with this prefix warm.
-                self.kv_pool.offload(req.prompt_ids[:plen], entry)
+        into the slot — shared tail of the suffix/chunked prefill paths."""
+        self._store_prefix(req, plen, pre_cache, last_logits)
         self.cache = self._insert(
             self.cache, pre_cache, slot, jnp.asarray(plen, jnp.int32)
         )
@@ -653,7 +797,8 @@ class InferenceEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :plen] = req.prompt_ids
             last_logits, pre_cache = self._prefill(
-                self.params, jnp.asarray(padded), jnp.asarray(plen, jnp.int32)
+                self.params, jnp.asarray(padded),
+                jnp.asarray([plen], jnp.int32)
             )
         self._finish_prefill(req, slot, plen, pre_cache, last_logits)
         return last_logits
@@ -761,7 +906,7 @@ class InferenceEngine:
         """One engine iteration. Returns False when fully idle."""
         with self._lock:
             self._admit()
-            progressed = self._advance_prefills()
+            progressed = self._advance_prefills(self.prefill_budget)
             active = [s for s, r in enumerate(self.slot_req)
                       if r is not None and self.slot_ready[s]]
             if not active:
@@ -780,6 +925,20 @@ class InferenceEngine:
                 self.pending.qsize() > 0
                 and any(r is None for r in self.slot_req)
             )
+            if n > 1 and self.pending.qsize() > 0:
+                # Requests are waiting on a slot: cap the block at the
+                # soonest *deterministic* completion among active slots
+                # (token budget or cache room, whichever bites first), so
+                # the freed slot refills at the very next step instead of
+                # idling out the tail of a fixed-length block. This is the
+                # TTFT half of multi-step scheduling: full blocks when
+                # nobody waits, shortest-useful blocks under queueing.
+                soonest = int(min(
+                    min(self.slot_budget[s],
+                        self.cache_len - 1 - self.slot_len[s])
+                    for s in active
+                ))
+                n = max(1, min(n, soonest))
             use_multi = (
                 n > 1
                 and self.speculative_k is None
@@ -798,9 +957,11 @@ class InferenceEngine:
                     jnp.asarray(self._top_k),
                     jnp.asarray(self._top_p),
                     jnp.asarray(self._greedy),
+                    n=n,
                 )
                 toks_host = np.asarray(toks)
                 self.multi_blocks += 1
+                self.multi_steps_total += n
                 for slot in active:
                     for j in range(n):
                         if self.slot_req[slot] is None:
