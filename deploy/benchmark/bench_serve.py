@@ -47,7 +47,7 @@ def _quantile(xs, q):
 
 
 def one_request(url, model, prompt, max_tokens, timeout):
-    """Returns (ok, ttft_s, tpot_list, n_tokens)."""
+    """Returns (ok, ttft_s, tpot_list, n_tokens, failure_reason)."""
     body = json.dumps({
         "model": model,
         "messages": [{"role": "user", "content": prompt}],
@@ -85,8 +85,12 @@ def one_request(url, model, prompt, max_tokens, timeout):
                     if ttft is None:
                         ttft = now - t0
                     stamps.append(now)
-    except OSError:
-        return False, None, [], 0
+    except OSError as e:
+        # record WHY — a lost request is a bug until shown otherwise; the
+        # artifact must carry the reason, not just a success-rate dip
+        return False, None, [], 0, f"{type(e).__name__}: {e}"
+    if ttft is None:
+        return False, None, [], 0, "stream_closed_without_tokens"
     # Per-request mean inter-token time, (last - first)/(n - 1) — the
     # `vllm bench serve` TPOT definition. Raw per-gap sampling breaks
     # under burst delivery (multi-step decode / speculative bursts emit
@@ -94,7 +98,24 @@ def one_request(url, model, prompt, max_tokens, timeout):
     # reads a whole block, so per-gap percentiles are meaningless).
     tpot = ([(stamps[-1] - stamps[0]) / (len(stamps) - 1)]
             if len(stamps) > 1 else [])
-    return ttft is not None, ttft, tpot, len(stamps)
+    return True, ttft, tpot, len(stamps), None
+
+
+def _aggregate(concurrency, n_requests, n_ok, failures, ttfts, tpots,
+               total_tokens, wall):
+    """Shared row schema for both ladders — one place to add a metric."""
+    return {
+        "concurrency": concurrency,
+        "requests": n_requests,
+        "success_rate": n_ok / max(n_requests, 1),
+        "failures": failures,
+        "output_tps": total_tokens / wall if wall else 0.0,
+        "ttft_p50_ms": _quantile(ttfts, 0.5) * 1e3,
+        "ttft_p99_ms": _quantile(ttfts, 0.99) * 1e3,
+        "tpot_p50_ms": _quantile(tpots, 0.5) * 1e3,
+        "tpot_p99_ms": _quantile(tpots, 0.99) * 1e3,
+        "wall_s": wall,
+    }
 
 
 def run_level(url, model, concurrency, n_requests, max_tokens, timeout):
@@ -123,20 +144,81 @@ def run_level(url, model, concurrency, n_requests, max_tokens, timeout):
     wall = time.perf_counter() - t0
 
     oks = [r for r in results if r[0]]
-    ttfts = [r[1] for r in oks]
-    tpots = [x for r in oks for x in r[2]]
-    total_tokens = sum(r[3] for r in oks)
-    return {
-        "concurrency": concurrency,
-        "requests": n_requests,
-        "success_rate": len(oks) / max(n_requests, 1),
-        "output_tps": total_tokens / wall if wall else 0.0,
-        "ttft_p50_ms": _quantile(ttfts, 0.5) * 1e3,
-        "ttft_p99_ms": _quantile(ttfts, 0.99) * 1e3,
-        "tpot_p50_ms": _quantile(tpots, 0.5) * 1e3,
-        "tpot_p99_ms": _quantile(tpots, 0.99) * 1e3,
-        "wall_s": wall,
-    }
+    failures: dict[str, int] = {}
+    for r in results:
+        if not r[0]:
+            failures[r[4]] = failures.get(r[4], 0) + 1
+    return _aggregate(
+        concurrency, n_requests, len(oks), failures,
+        [r[1] for r in oks], [x for r in oks for x in r[2]],
+        sum(r[3] for r in oks), wall)
+
+
+def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
+                        max_tokens, timeout=600.0):
+    """Closed-loop ladder directly against ``InferenceEngine.submit`` — no
+    HTTP, no SSE, no tunnel-side parsing. TTFT/TPOT come from the engine's
+    own per-request stamps (``Request.ttft_s`` / ``tpot_s``), so this row
+    is **engine-attributable**: it isolates scheduler + device time from
+    the ~100-150 ms/dispatch remote-tunnel RTT that dominates the HTTP
+    ladder's latency numbers. The engine's background thread must be
+    running (``engine.start()``). Like the HTTP client, every failure
+    carries a reason and a dead engine thread surfaces as per-request
+    timeouts instead of a hang.
+    """
+    import queue as queue_mod
+
+    from llm_in_practise_tpu.serve import engine as engine_mod
+    from llm_in_practise_tpu.serve.engine import SamplingParams
+
+    done = []          # (request | None, failure_reason | None)
+    lock = threading.Lock()
+    queue = list(range(n_requests))
+    rng = random.Random(0)
+    picks = [rng.randrange(len(prompt_ids_list)) for _ in range(n_requests)]
+
+    def worker():
+        while True:
+            with lock:
+                if not queue:
+                    return
+                i = queue.pop()
+            try:
+                req = engine.submit(prompt_ids_list[picks[i]],
+                                    SamplingParams(greedy=True,
+                                                   max_tokens=max_tokens))
+                while True:  # drain the stream; bounded wait per token
+                    item = req.tokens.get(timeout=timeout)
+                    if item is engine_mod._FINISH:
+                        break
+                row = (req, None)
+            except queue_mod.Empty:
+                row = (None, f"token_timeout>{timeout:g}s")
+            except Exception as e:
+                row = (None, f"{type(e).__name__}: {e}")
+            with lock:
+                done.append(row)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    oks = [r for r, err in done if err is None and r.finish_time is not None]
+    failures: dict[str, int] = {}
+    for r, err in done:
+        reason = err or ("no_finish_time" if r.finish_time is None else None)
+        if reason:
+            failures[reason] = failures.get(reason, 0) + 1
+    row = _aggregate(
+        concurrency, n_requests, len(oks), failures,
+        [r.ttft_s for r in oks if r.ttft_s is not None],
+        [r.tpot_s for r in oks if r.tpot_s is not None],
+        sum(r.n_generated for r in oks), wall)
+    return {"mode": "inprocess", **row}
 
 
 def main():
