@@ -1,0 +1,75 @@
+"""End-to-end PTQ pipeline on the committed HF-format golden checkpoint
+(VERDICT r2 item 8): calibrate → AWQ-quantize → packed 4-bit export →
+reload → serve through the continuous-batching engine (W4A16 fused path)
+→ PPL acceptance gate — ONE test walking the reference's
+``Quantization/LoRA-AWQ`` pipeline shape
+(``quantize-deepseek-r1-qwen3-8b-awq.py``) on a real HF artifact
+(``tests/fixtures/qwen3_tiny``), not per-stage on synthetic trees."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_in_practise_tpu.models.hf_loader import load_qwen3
+from llm_in_practise_tpu.quant import io as quant_io
+from llm_in_practise_tpu.quant import ppl
+from llm_in_practise_tpu.quant.awq import AWQConfig, AWQTensor, quantize_model_awq
+from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+from llm_in_practise_tpu.serve.quantized import QuantizedModel
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "qwen3_tiny")
+
+
+def test_ptq_pipeline_end_to_end(tmp_path):
+    model, params = load_qwen3(FIXTURE, dtype=jnp.float32)
+    vocab = model.config.vocab_size
+
+    # 1. calibration set: structured sequences over the checkpoint's vocab
+    rng = np.random.default_rng(0)
+    calib_seqs = [rng.integers(0, vocab, size=24).tolist() for _ in range(8)]
+    calib_batches = [jnp.asarray(calib_seqs[i:i + 4], jnp.int32)
+                     for i in range(0, 8, 4)]
+
+    # 2. AWQ PTQ over every Dense kernel except lm_head (the reference's
+    #    ignore list)
+    qtree = quantize_model_awq(
+        model, params, calib_batches, AWQConfig(group_size=32),
+        target=lambda key: "lm_head" not in key,
+    )
+    n_q = sum(isinstance(v, AWQTensor) for v in jax.tree_util.tree_leaves(
+        qtree, is_leaf=lambda x: isinstance(x, AWQTensor)))
+    assert n_q > 0, "no kernels were quantized"
+
+    # 3. packed 4-bit export -> reload (what a serving host would load)
+    out = str(tmp_path / "qwen3_tiny_awq")
+    quant_io.save_packed(out, qtree, metadata={"method": "awq", "bits": 4})
+    loaded, meta = quant_io.load_packed(out)
+    assert meta["method"] == "awq"
+
+    # 4. serve the RELOADED packed tree through the engine (W4A16 fused
+    #    kernels; no bf16 weight copy ever materializes)
+    qm = QuantizedModel(model, compute_dtype=jnp.float32)
+    engine = InferenceEngine(qm, loaded, max_slots=2, cache_len=64,
+                             cache_dtype=jnp.float32)
+    prompt = calib_seqs[0][:12]
+    served = engine.generate(prompt, SamplingParams(greedy=True, max_tokens=8))
+    assert len(served) == 8 and all(0 <= t < vocab for t in served)
+
+    # 5. PPL acceptance gate, FP vs reloaded-quantized — the reference's
+    #    two-row verdict table (eval_qwen3_4b_gptq.py:74-81). The fixture
+    #    is a random-init tiny model (PPL ~ vocab), so the gate is
+    #    relative: quantization must not degrade PPL by more than 10%.
+    eval_seqs = [rng.integers(0, vocab, size=24).tolist() for _ in range(8)]
+    batches = ppl.make_batches(eval_seqs, batch_size=4, max_len=32)
+
+    def apply_fn(p, x):
+        return qm.apply({"params": p}, x, deterministic=True)
+
+    fp = ppl.evaluate_ppl(apply_fn, params, batches, threshold=float("inf"))
+    gate = fp.mean_ppl * 1.10
+    verdict = ppl.compare_quantized(apply_fn, params, loaded, batches,
+                                    threshold=gate)
+    assert verdict["passed"], verdict
+    assert verdict["quant_ppl"] <= gate
