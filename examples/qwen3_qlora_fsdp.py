@@ -34,7 +34,7 @@ from llm_in_practise_tpu.models import Qwen3, qwen3_config
 from llm_in_practise_tpu.peft import (
     LoRAConfig,
     init_lora,
-    make_qlora_loss_fn,
+    make_qlora_loss_fn_args,
     memory_report,
     qlora_apply,
     quantize_base,
@@ -124,13 +124,15 @@ def main():
         )[..., 0]
         return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
 
-    loss_fn = make_qlora_loss_fn(qparams, lcfg, base_loss)
+    # frozen base as an ARGUMENT (not a closure const): keeps the NF4
+    # tree out of the serialized program — see peft/qlora.py docstrings
+    loss_fn = make_qlora_loss_fn_args(lcfg, base_loss)
     tx = optax.adamw(args.lr)
     opt_state = tx.init(lora_params)
 
     @jax.jit
-    def train_step(lp, opt_state, idx):
-        loss, grads = jax.value_and_grad(loss_fn)(lp, idx, None)
+    def train_step(lp, opt_state, qp, idx):
+        loss, grads = jax.value_and_grad(loss_fn)(lp, qp, idx, None)
         updates, opt_state = tx.update(grads, opt_state, lp)
         return optax.apply_updates(lp, updates), opt_state, loss
 
@@ -139,7 +141,7 @@ def main():
         for step in range(args.steps):
             idx = jnp.asarray(rng.integers(0, len(x), (args.batch_size,)))
             lora_params, opt_state, loss = train_step(
-                lora_params, opt_state, idx)
+                lora_params, opt_state, qparams, idx)
             if step % 10 == 0 or step == args.steps - 1:
                 print(f"step {step} | loss {float(loss):.4f}")
 

@@ -8,6 +8,7 @@ from llm_in_practise_tpu.peft.lora import (
 )
 from llm_in_practise_tpu.peft.qlora import (
     make_qlora_loss_fn,
+    make_qlora_loss_fn_args,
     memory_report,
     qlora_apply,
     quantize_base,
@@ -25,6 +26,7 @@ __all__ = [
     "init_lora",
     "make_fused_qlora_loss_fn",
     "make_qlora_loss_fn",
+    "make_qlora_loss_fn_args",
     "memory_report",
     "merge_lora",
     "qlora_apply",

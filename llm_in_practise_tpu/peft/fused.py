@@ -170,7 +170,12 @@ def make_fused_qlora_loss_fn(model, qparams, cfg: lora_lib.LoRAConfig,
                              base_loss_fn, compute_dtype=jnp.bfloat16):
     """Like :func:`..peft.qlora.make_qlora_loss_fn` but the forward runs
     through the fused kernel. ``base_loss_fn(apply_out_fn, batch, rng)``
-    receives a closure ``apply_out_fn(*args, **kw) -> model output``."""
+    receives a closure ``apply_out_fn(*args, **kw) -> model output``.
+
+    Closes over ``qparams`` — see the closure caveat on
+    :func:`..peft.qlora.make_qlora_loss_fn` (docs/perf.md Finding 6)
+    before jitting this through a remote/AOT compile path with a
+    multi-GB base."""
 
     def loss_fn(lora_params, batch, rng):
         def apply_out(*args, **kw):

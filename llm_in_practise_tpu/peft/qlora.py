@@ -40,6 +40,14 @@ def quantize_base(params, *, min_size: int = 4096):
         params, lambda p, leaf: _quant_predicate(p, leaf, min_size))
 
 
+# Module-level jitted helpers: callers that quantize layer-by-layer (the
+# multi-B distinct-weights path) hit the same compiled executable for every
+# layer — per-call jax.jit wrappers would recompile identical programs.
+_quantize_donated = jax.jit(nf4.quantize, donate_argnums=0)
+_cast_bf16_donated = jax.jit(lambda v: v.astype(jnp.bfloat16),
+                             donate_argnums=0)
+
+
 def quantize_base_lowmem(params, *, min_size: int = 4096,
                          cast_rest_above: int | None = 1_000_000):
     """:func:`quantize_base` for multi-billion-param trees on one chip.
@@ -54,17 +62,14 @@ def quantize_base_lowmem(params, *, min_size: int = 4096,
     """
     from llm_in_practise_tpu.utils.tree import path_str
 
-    q = jax.jit(nf4.quantize, donate_argnums=0)
-    cast = jax.jit(lambda v: v.astype(jnp.bfloat16), donate_argnums=0)
-
     def maybe(path, leaf):
         s = path_str(path)
         if _quant_predicate(s, leaf, min_size):
-            return q(leaf)
+            return _quantize_donated(leaf)
         if (cast_rest_above is not None
                 and getattr(leaf, "dtype", None) == jnp.float32
                 and leaf.size > cast_rest_above):
-            return cast(leaf)
+            return _cast_bf16_donated(leaf)
         return leaf
 
     return jax.tree_util.tree_map_with_path(maybe, params)
@@ -84,8 +89,33 @@ def qlora_apply(qparams, lora_params, cfg: lora_lib.LoRAConfig,
 
 def make_qlora_loss_fn(qparams, cfg: lora_lib.LoRAConfig,
                        base_loss_fn, dtype=jnp.bfloat16):
-    """Wrap a ``loss_fn(params, batch, rng)`` into one over LoRA params only."""
+    """Wrap a ``loss_fn(params, batch, rng)`` into one over LoRA params only.
+
+    **Closure caveat**: this closes over ``qparams``, so the frozen tree is
+    baked into the jitted program as constants. Local backends dedupe that
+    fine; a REMOTE compile service receives the constants inside the
+    serialized module — measured on the axon AOT tunnel: 247 s "compile"
+    at 32k vocab, un-compilable (>25 min / HTTP 413) at Qwen3's 151936,
+    vs <10 s either way with the frozen tree as an argument
+    (``VOCAB_PROBE.json``). Prefer :func:`make_qlora_loss_fn_args` for
+    multi-GB bases.
+    """
     def loss_fn(lora_params, batch, rng):
+        params = qlora_apply(qparams, lora_params, cfg, dtype)
+        return base_loss_fn(params, batch, rng)
+
+    return loss_fn
+
+
+def make_qlora_loss_fn_args(cfg: lora_lib.LoRAConfig, base_loss_fn,
+                            dtype=jnp.bfloat16):
+    """Like :func:`make_qlora_loss_fn` but the frozen base is an ARGUMENT:
+    ``loss(lora_params, qparams, batch, rng)``. The multi-GB NF4 tree
+    stays out of the serialized program (jit it with ``qparams`` in
+    ``argnums`` position 1 and differentiate w.r.t. position 0 only), so
+    remote/AOT compile uploads stay small and compile time is independent
+    of base size."""
+    def loss_fn(lora_params, qparams, batch, rng):
         params = qlora_apply(qparams, lora_params, cfg, dtype)
         return base_loss_fn(params, batch, rng)
 
