@@ -1,0 +1,247 @@
+"""Course datasets — synthetic, seeded analogs of the reference data files.
+
+The reference ships five scraped/collected datasets under ``DataSets/*``
+(e-commerce user features, game-launch review comments + player info,
+online-education courses, a web-novel catalog, and a short-video
+e-commerce user-feature table — plus a mum-baby purchase sample) that its
+ML notebooks consume. Scraped data cannot be redistributed from here, so
+this module *generates* datasets with the same schema shapes, value
+domains, and planted statistical structure (correlations a curriculum can
+actually teach against), deterministically from a seed.
+
+Reference counterparts (schema parity, not data parity):
+  - ``DataSets/电商用户数据集/user_personalized_features.csv``
+  - ``DataSets/黑神话悟空上线初期评论集/{wukong.xlsx,部分用户信息.csv}``
+  - ``DataSets/在线教育课程数据集/courses.csv``
+  - ``DataSets/起点小说网数据集/起点精品小说合集.xlsx``
+  - ``DataSets/抖音电商用户特征/user_personalized_features.csv``
+  - ``DataSets/(sample)sam_tianchi_mum_baby.csv``
+
+Each generator returns a ``pandas.DataFrame``; ``generate_all`` writes the
+committed CSVs under ``mlops/course_datasets/data/``. Regenerating with
+the default seed reproduces the committed files byte-for-byte, which the
+tests assert.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+_INTERESTS = ("Sports", "Technology", "Fashion", "Cooking", "Travel",
+              "Gaming", "Reading")
+_CATEGORIES = ("Books", "Electronics", "Clothing", "Home", "Beauty",
+               "Toys")
+_LOCATIONS = ("Urban", "Suburban", "Rural")
+
+
+def ecommerce_users(n: int = 1000, seed: int = 0) -> pd.DataFrame:
+    """User-level e-commerce features with planted structure: spending
+    scales with income and engagement; newsletter subscribers browse
+    longer. Columns mirror ``user_personalized_features.csv``."""
+    rng = np.random.default_rng(seed)
+    income = rng.integers(20_000, 160_000, n)
+    engagement = rng.beta(2, 4, n)                    # latent browse habit
+    freq = np.clip(rng.poisson(1 + 8 * engagement), 0, 30)
+    aov = np.round(10 + income / 2000 + rng.gamma(2.0, 15.0, n), 2)
+    newsletter = rng.random(n) < (0.2 + 0.5 * engagement)
+    df = pd.DataFrame({
+        "User_ID": [f"#{i + 1}" for i in range(n)],
+        "Age": rng.integers(18, 70, n),
+        "Gender": rng.choice(["Male", "Female"], n),
+        "Location": rng.choice(_LOCATIONS, n, p=[0.45, 0.35, 0.2]),
+        "Income": income,
+        "Interests": rng.choice(_INTERESTS, n),
+        "Last_Login_Days_Ago": np.clip(
+            rng.geometric(0.08, n) - 1, 0, 60),
+        "Purchase_Frequency": freq,
+        "Average_Order_Value": aov,
+        "Total_Spending": np.round(freq * aov * rng.uniform(0.8, 1.2, n)),
+        "Product_Category_Preference": rng.choice(_CATEGORIES, n),
+        "Time_Spent_on_Site_Minutes": np.round(
+            30 + 600 * engagement + 60 * newsletter
+            + rng.normal(0, 20, n)).clip(1).astype(int),
+        "Pages_Viewed": np.round(
+            3 + 50 * engagement + rng.normal(0, 4, n)).clip(1).astype(int),
+        "Newsletter_Subscription": newsletter,
+    })
+    return df
+
+
+_REVIEW_POS = ("Fantastic boss fights and art direction.",
+               "Runs smoothly after the day-one patch, loving it.",
+               "Combat feel is incredible, worth every minute.",
+               "The mythology retelling is gorgeous.",
+               "Best action game I have played this year.")
+_REVIEW_NEG = ("Crashes on chapter two, waiting for a fix.",
+               "Camera gets stuck in tight arenas constantly.",
+               "Performance drops hard in the open areas.",
+               "Difficulty spikes feel unfair, not challenging.",
+               "Refunded after repeated save corruption.")
+_REGIONS = ("China", "United States", "Japan", "Germany", "Brazil",
+            "Bangladesh", "France")
+
+
+def game_review_comments(n: int = 800, seed: int = 1) -> pd.DataFrame:
+    """Launch-window game reviews + player profile columns (merging the
+    reference's ``wukong.xlsx`` comments with its player-info CSV):
+    sentiment-labeled text for NLP exercises, numeric profile columns for
+    tabular ones. Veteran players (more achievements) skew positive."""
+    rng = np.random.default_rng(seed)
+    achievements = rng.integers(0, 200, n)
+    p_pos = 0.45 + 0.3 * (achievements / 200)
+    positive = rng.random(n) < p_pos
+    text = np.where(positive,
+                    rng.choice(_REVIEW_POS, n),
+                    rng.choice(_REVIEW_NEG, n))
+    hours = np.round(rng.gamma(2.0, 20.0, n), 1)
+    df = pd.DataFrame({
+        "review_id": np.arange(1, n + 1),
+        "username": [f"player_{i:04d}" for i in range(n)],
+        "region": rng.choice(_REGIONS, n,
+                             p=[0.5, 0.15, 0.1, 0.08, 0.07, 0.05, 0.05]),
+        "player_level": rng.integers(1, 80, n),
+        "badges": rng.integers(0, 40, n),
+        "games_owned": rng.integers(1, 400, n),
+        "achievements": achievements,
+        "hours_played": hours,
+        "recommended": positive,
+        "review_text": text,
+    })
+    return df
+
+
+_COURSE_CATS = ("Business", "Data Science", "Design", "Programming",
+                "Language", "Marketing")
+
+
+def online_courses(n: int = 900, seed: int = 2) -> pd.DataFrame:
+    """Online-education course catalog mirroring ``courses.csv``:
+    completion rate correlates with evaluation and inversely with
+    chapter count; exam scores track completion."""
+    rng = np.random.default_rng(seed)
+    chapters = rng.integers(5, 150, n)
+    evaluation = np.round(rng.uniform(1.0, 5.0, n), 1)
+    completion = np.clip(
+        68 - 0.15 * chapters + 5 * evaluation + rng.normal(0, 6, n),
+        5, 100).round(2)
+    df = pd.DataFrame({
+        "Course_ID": rng.permutation(np.arange(1, n + 1)),
+        "Category": rng.choice(_COURSE_CATS, n),
+        "Duration (hours)": rng.choice([10, 20, 40, 60], n),
+        "Chapter_Number": chapters,
+        "Enrolled_Students": rng.integers(50, 6000, n),
+        "Completion_Rate (%)": completion,
+        "Platform_Number": rng.integers(1, 6, n),
+        "Price": rng.integers(0, 200, n),
+        "Course_Evaluation": evaluation,
+        "Examination_Average_Score": np.round(
+            30 + 0.55 * completion + rng.normal(0, 8, n)).clip(0, 100)
+            .astype(int),
+    })
+    return df
+
+
+_NOVEL_GENRES = ("Fantasy", "Wuxia", "Sci-Fi", "Urban", "History",
+                 "Game-Lit")
+
+
+def novel_catalog(n: int = 600, seed: int = 3) -> pd.DataFrame:
+    """Web-novel catalog analog of the Qidian collection: long-tailed
+    popularity (a few mega-hits), word count growing with chapter
+    count, completion status."""
+    rng = np.random.default_rng(seed)
+    chapters = rng.integers(20, 3000, n)
+    words_per_chapter = rng.normal(2100, 300, n).clip(800)
+    collections = np.round(rng.pareto(1.2, n) * 5000).astype(int)
+    df = pd.DataFrame({
+        "novel_id": np.arange(1, n + 1),
+        "title": [f"novel_{i:04d}" for i in range(n)],
+        "genre": rng.choice(_NOVEL_GENRES, n),
+        "author": [f"author_{int(a):03d}"
+                   for a in rng.integers(0, 250, n)],
+        "chapters": chapters,
+        "word_count": (chapters * words_per_chapter).astype(int),
+        "collections": collections,
+        "recommend_votes": (collections * rng.uniform(0.5, 3.0, n))
+            .astype(int),
+        "is_finished": rng.random(n) < 0.35,
+        "rating": np.round(rng.uniform(5.0, 9.8, n), 1),
+    })
+    return df
+
+
+def shortvideo_user_features(n: int = 1000, seed: int = 4) -> pd.DataFrame:
+    """Short-video e-commerce user features (the reference's Douyin table
+    reuses the e-commerce schema plus an index column — same here, with a
+    different seed so the two tables are distinct)."""
+    df = ecommerce_users(n, seed=seed)
+    df.insert(0, "row_index", np.arange(n))
+    return df
+
+
+def mum_baby_sample(n: int = 500, seed: int = 5) -> pd.DataFrame:
+    """Tianchi mum-baby sample analog: (user_id, birthday YYYYMMDD,
+    gender) rows for groupby/date-parsing exercises."""
+    rng = np.random.default_rng(seed)
+    years = rng.integers(2008, 2015, n)
+    months = rng.integers(1, 13, n)
+    days = rng.integers(1, 29, n)
+    # direct draws instead of sampling an arange(1e8) without replacement
+    # (which materializes ~0.8 GB); collisions in 500 of 1e8 are ~1e-3
+    # likely and absent at this seed, but redraw until unique regardless
+    user_id = rng.integers(1_000, 100_000_000, n)
+    while len(np.unique(user_id)) < n:
+        user_id = np.unique(
+            np.concatenate([user_id,
+                            rng.integers(1_000, 100_000_000, n)]))[:n]
+    df = pd.DataFrame({
+        "user_id": np.sort(user_id),
+        "birthday": years * 10_000 + months * 100 + days,
+        "gender": rng.integers(0, 2, n),
+    })
+    return df
+
+
+GENERATORS = {
+    "ecommerce_users": ecommerce_users,
+    "game_review_comments": game_review_comments,
+    "online_courses": online_courses,
+    "novel_catalog": novel_catalog,
+    "shortvideo_user_features": shortvideo_user_features,
+    "mum_baby_sample": mum_baby_sample,
+}
+
+
+def generate_all(out_dir: str = DATA_DIR) -> dict[str, str]:
+    """Write every dataset as CSV; returns {name: path}."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {}
+    for name, gen in GENERATORS.items():
+        path = os.path.join(out_dir, f"{name}.csv")
+        gen().to_csv(path, index=False)
+        paths[name] = path
+    return paths
+
+
+def load(name: str) -> pd.DataFrame:
+    """Load a committed dataset by name (regenerates just that CSV if
+    missing — the generator IS the source of truth)."""
+    if name not in GENERATORS:
+        raise KeyError(
+            f"unknown dataset {name!r}; have {sorted(GENERATORS)}")
+    path = os.path.join(DATA_DIR, f"{name}.csv")
+    if not os.path.exists(path):
+        os.makedirs(DATA_DIR, exist_ok=True)
+        GENERATORS[name]().to_csv(path, index=False)
+    return pd.read_csv(path)
+
+
+if __name__ == "__main__":
+    for name, path in generate_all().items():
+        df = pd.read_csv(path)
+        print(f"{name}: {len(df)} rows x {len(df.columns)} cols -> {path}")

@@ -1,0 +1,91 @@
+"""Course-dataset generators: schema, determinism, planted structure.
+
+Mirrors the reference's only formal unit test — synthetic-data shape and
+column assertions (``ML_Basics/fault_prediction_project/tests/
+test_data_generation.py:1-12``) — and extends it with determinism (the
+committed CSVs must equal a regeneration) and a learnability check (the
+planted correlations are strong enough for the curriculum to teach
+against).
+"""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mlops.course_datasets.generate import (
+    DATA_DIR, GENERATORS, ecommerce_users, game_review_comments, load,
+    mum_baby_sample, online_courses,
+)
+
+EXPECTED_COLS = {
+    "ecommerce_users": 14,
+    "game_review_comments": 10,
+    "online_courses": 10,
+    "novel_catalog": 10,
+    "shortvideo_user_features": 15,
+    "mum_baby_sample": 3,
+}
+
+
+@pytest.mark.parametrize("name", sorted(GENERATORS))
+def test_schema_and_shape(name):
+    df = GENERATORS[name]()
+    assert len(df) >= 500
+    assert len(df.columns) == EXPECTED_COLS[name]
+    assert not df.isna().any().any()
+
+
+@pytest.mark.parametrize("name", sorted(GENERATORS))
+def test_committed_csv_matches_generator_bytes(name):
+    import os
+    with open(os.path.join(DATA_DIR, f"{name}.csv"), "rb") as f:
+        committed = f.read()
+    buf = io.StringIO()
+    GENERATORS[name]().to_csv(buf, index=False)
+    assert committed == buf.getvalue().encode()
+
+
+def test_ecommerce_planted_structure():
+    df = ecommerce_users()
+    # spending tracks purchase frequency; subscribers browse longer
+    assert df["Total_Spending"].corr(df["Purchase_Frequency"]) > 0.3
+    subs = df.groupby("Newsletter_Subscription")[
+        "Time_Spent_on_Site_Minutes"].mean()
+    assert subs[True] > subs[False]
+
+
+def test_reviews_usable_for_sentiment():
+    df = game_review_comments()
+    # labels are balanced enough to train against, and text determines
+    # the label exactly (each template is pos-only or neg-only)
+    rate = df["recommended"].mean()
+    assert 0.3 < rate < 0.8
+    by_text = df.groupby("review_text")["recommended"].nunique()
+    assert (by_text == 1).all()
+
+
+def test_courses_completion_drives_scores():
+    df = online_courses()
+    assert df["Examination_Average_Score"].corr(
+        df["Completion_Rate (%)"]) > 0.5
+    assert df["Completion_Rate (%)"].between(5, 100).all()
+
+
+def test_mum_baby_dates_parse():
+    df = mum_baby_sample()
+    parsed = pd.to_datetime(df["birthday"], format="%Y%m%d")
+    assert parsed.dt.year.between(2008, 2014).all()
+    assert df["user_id"].is_unique
+    assert set(df["gender"].unique()) <= {0, 1}
+
+
+def test_loader_round_trip(tmp_path):
+    with pytest.raises(KeyError):
+        load("nope")
+    df = load("novel_catalog")
+    assert (df["word_count"] >= df["chapters"] * 800).all()
+    assert DATA_DIR.endswith("data")
+    # long-tailed popularity: the top novel dwarfs the median
+    assert df["collections"].max() > 20 * df["collections"].median()
