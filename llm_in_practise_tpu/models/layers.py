@@ -28,6 +28,48 @@ Cache = dict[str, Any]
 dense_init = nn.initializers.normal(stddev=0.02)
 
 
+# --- scan sideband ---------------------------------------------------------
+# Trace-time channel between a scan-over-layers body and flax method
+# interceptors installed OUTSIDE the scan (peft/fused.py): the body
+# publishes its per-iteration sliced side inputs (e.g. one layer's packed
+# quantized weights, arriving as scanned ``xs``) so the interceptor can
+# serve the *current* layer's tensors even though its closure only holds
+# the full stacked tree. The published values are tracers; they are only
+# meaningful during the single trace of the scan body, which is exactly
+# when interceptors run. Thread-local: engines trace their jitted
+# programs from their own threads (one per engine under OpenAIServer
+# adapters), and a shared stack would cross-talk between traces.
+import threading as _threading
+
+_SCAN_SIDEBAND = _threading.local()
+
+
+class scan_sideband:
+    """Context manager publishing ``value`` for the duration of a scan
+    body's trace. Nested scans stack; per-thread."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __enter__(self):
+        stack = getattr(_SCAN_SIDEBAND, "stack", None)
+        if stack is None:
+            stack = _SCAN_SIDEBAND.stack = []
+        stack.append(self.value)
+        return self.value
+
+    def __exit__(self, *exc):
+        _SCAN_SIDEBAND.stack.pop()
+        return False
+
+
+def current_scan_sideband():
+    """This thread's innermost published sideband value, or None outside
+    a scan body's trace."""
+    stack = getattr(_SCAN_SIDEBAND, "stack", None)
+    return stack[-1] if stack else None
+
+
 def remat_apply(block: nn.Module, *args, **call_kwargs):
     """Apply a transformer block under gradient checkpointing.
 

@@ -53,8 +53,10 @@ class Qwen3Config:
     # at 28+ layers the unrolled HLO takes tens of minutes to compile.
     # Params are stored STACKED (leading n_layer axis, under "blocks");
     # use stack_layer_params / unstack_layer_params to convert to/from
-    # the unrolled per-block layout (HF interop, cached decode).
-    # Training-path only: cached decode uses the unrolled layout.
+    # the unrolled per-block layout (HF interop). Cached decode works in
+    # BOTH layouts: under scan the KV cache is stacked too (leading
+    # n_layer axis, slot axis 1 — see ``init_cache``) and each scan step
+    # carries its layer's KV slice as a scanned input/output.
     scan_layers: bool = False
 
     def replace(self, **kw) -> "Qwen3Config":
@@ -116,7 +118,24 @@ class RMSNorm(nn.Module):
 def init_cache(
     cfg: Qwen3Config, batch: int, max_len: int, dtype=jnp.bfloat16
 ) -> list[Cache]:
-    """Static-shape per-layer KV cache holding only the KV-head groups."""
+    """Static-shape per-layer KV cache holding only the KV-head groups.
+
+    Unrolled layout: one ``{k, v, index}`` dict per layer, slot (batch)
+    axis 0. Scan layout (``cfg.scan_layers``): ONE dict whose k/v carry a
+    leading ``n_layer`` axis (slot axis 1) and a single shared ``index``
+    — every layer advances in lockstep, so per-layer indices are
+    redundant. It is wrapped in a one-element list so engine code that
+    iterates per-layer dicts traverses both layouts identically."""
+    if cfg.scan_layers:
+        return [
+            {
+                "k": jnp.zeros((cfg.n_layer, batch, max_len,
+                                cfg.n_kv_head, cfg.head_dim), dtype),
+                "v": jnp.zeros((cfg.n_layer, batch, max_len,
+                                cfg.n_kv_head, cfg.head_dim), dtype),
+                "index": jnp.zeros((), jnp.int32),
+            }
+        ]
     return [
         {
             "k": jnp.zeros((batch, max_len, cfg.n_kv_head, cfg.head_dim), dtype),
@@ -252,6 +271,29 @@ class _ScanBody(nn.Module):
         return x, None
 
 
+class _ScanDecodeBody(nn.Module):
+    """One cached-decode scan step: the layer's KV slice rides as a
+    scanned input and the refreshed slice as the scanned output, so the
+    decode program compiles ONE block regardless of depth (the serving
+    analog of the training-path ``_ScanBody``). The write ``index`` is
+    shared by every layer (lockstep) and is broadcast, not scanned; the
+    per-layer index the block returns is dropped — the caller advances
+    the shared one once. ``sideband`` (scanned, may be empty) is this
+    layer's slice of caller-provided side inputs — e.g. packed quantized
+    weights — published via :func:`..layers.scan_sideband` for method
+    interceptors (peft/fused.py) during the body's trace."""
+
+    cfg: Qwen3Config
+
+    @nn.compact
+    def __call__(self, x, kv, index, sideband, rope_tables, positions):
+        layer_cache = {"k": kv["k"], "v": kv["v"], "index": index}
+        with layers.scan_sideband(sideband):
+            x, new = Qwen3Block(self.cfg, name="block")(
+                x, rope_tables, cache=layer_cache, positions=positions)
+        return x, {"k": new["k"], "v": new["v"]}
+
+
 def stack_layer_params(params: dict, n_layer: int) -> dict:
     """Unrolled ``block_i`` subtrees -> the scan layout (stacked leaves
     with a leading ``n_layer`` axis under ``blocks/block``)."""
@@ -285,9 +327,20 @@ class Qwen3(nn.Module):
         cache: list[Cache] | None = None,
         positions: jax.Array | None = None,
         return_hidden: bool = False,  # final-norm hidden states (embedder use)
+        # Per-layer side inputs for the scan-decode path (leading n_layer
+        # axis; e.g. stacked packed quantized weights) — scanned alongside
+        # the KV slices and published to interceptors via the
+        # layers.scan_sideband channel. Only valid with scan_layers+cache.
+        scan_sideband: Any = None,
     ):
         cfg = self.cfg
         compute_dtype = jnp.dtype(cfg.compute_dtype)
+        if scan_sideband is not None and not (
+            cfg.scan_layers and cache is not None
+        ):
+            raise ValueError(
+                "scan_sideband is only consumed by the scan-layers cached "
+                "decode path (scan_layers=True with a cache)")
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size,
             embedding_init=nn.initializers.normal(0.02), name="tok_embed",
@@ -300,18 +353,34 @@ class Qwen3(nn.Module):
         new_caches: list[Cache] | None = [] if cache is not None else None
         if cfg.scan_layers:
             if cache is not None:
-                raise NotImplementedError(
-                    "scan_layers is the training-path layout; convert with "
-                    "unstack_layer_params(...) and scan_layers=False for "
-                    "cached decode")
-            scan = nn.scan(
-                _ScanBody,
-                variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast),
-                length=cfg.n_layer,
-            )
-            x, _ = scan(cfg, name="blocks")(x, rope_tables, positions)
+                stacked = cache[0]
+                if positions is None:
+                    positions = layers.cache_positions(
+                        stacked["index"], idx.shape[0], idx.shape[1])
+                scan = nn.scan(
+                    _ScanDecodeBody,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True, "dropout": True},
+                    in_axes=(0, nn.broadcast, 0, nn.broadcast,
+                             nn.broadcast),
+                    out_axes=0,
+                    length=cfg.n_layer,
+                )
+                x, kv = scan(cfg, name="blocks")(
+                    x, {"k": stacked["k"], "v": stacked["v"]},
+                    stacked["index"], scan_sideband, rope_tables,
+                    positions)
+                new_caches = [{"k": kv["k"], "v": kv["v"],
+                               "index": stacked["index"] + idx.shape[1]}]
+            else:
+                scan = nn.scan(
+                    _ScanBody,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True, "dropout": True},
+                    in_axes=(nn.broadcast, nn.broadcast),
+                    length=cfg.n_layer,
+                )
+                x, _ = scan(cfg, name="blocks")(x, rope_tables, positions)
         else:
             for i in range(cfg.n_layer):
                 layer_cache = cache[i] if cache is not None else None
@@ -353,3 +422,10 @@ class Qwen3(nn.Module):
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
         return init_cache(self.cfg, batch, max_len, dtype)
+
+    @property
+    def cache_slot_axis(self) -> int:
+        """Which axis of the KV buffers indexes the slot (batch): 0 in
+        the unrolled layout, 1 under the stacked scan layout (axis 0 is
+        the layer). Serving code reads this to stay layout-agnostic."""
+        return 1 if self.cfg.scan_layers else 0

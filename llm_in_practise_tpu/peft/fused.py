@@ -113,12 +113,47 @@ def qlora_fused_apply(
     # init_lora's tree is already keyed by kernel path: {path: {"a", "b"}}
     lora_by_path: dict[str, dict] = lora_params or {}
 
+    # Scan-layers serving: block quant leaves live STACKED under
+    # "blocks/block/..." (leading n_layer axis per component). They can't
+    # be served from this closure — inside the scan the interceptor needs
+    # the CURRENT layer's slice, which only exists as the body's scanned
+    # input. Route them through the model's scan_sideband channel (the
+    # body publishes its slice via layers.scan_sideband; the interceptor
+    # reads layers.current_scan_sideband). Keys match module paths
+    # exactly because the sideband dict is keyed the same way.
+    scan_mode = bool(getattr(getattr(model, "config", None) or
+                             getattr(model, "cfg", None),
+                             "scan_layers", False))
+    sideband = None
+    if scan_mode:
+        sideband = {k: v for k, v in quant.items()
+                    if k.startswith("blocks/block/")}
+        if sideband:
+            if apply_kwargs.get("cache") is None:
+                raise NotImplementedError(
+                    "fused serving of a scan-layers quant tree runs "
+                    "through the cached-decode scan (the training scan "
+                    "body has no sideband); pass a cache, or unstack "
+                    "with unstack_layer_params + scan_layers=False")
+            quant = {k: v for k, v in quant.items() if k not in sideband}
+            apply_kwargs = dict(apply_kwargs, scan_sideband=sideband)
+    n_layer = getattr(getattr(model, "config", None) or
+                      getattr(model, "cfg", None), "n_layer", None)
+
     # Dense never reads its kernel when intercepted — swap quantized
     # leaves for tiny placeholders so the params tree stays a valid array
-    # pytree without materializing the dequantized weight.
-    placeholders = jax.tree_util.tree_map(
-        lambda v: jnp.zeros((1, 1), compute_dtype) if _is_quant(v) else v,
-        qparams, is_leaf=_is_quant,
+    # pytree without materializing the dequantized weight. Stacked scan
+    # leaves get a leading n_layer axis so nn.scan can slice them.
+    def _placeholder(path, v):
+        if not _is_quant(v):
+            return v
+        from llm_in_practise_tpu.utils.tree import path_str
+        if sideband and path_str(path) in sideband:
+            return jnp.zeros((n_layer, 1, 1), compute_dtype)
+        return jnp.zeros((1, 1), compute_dtype)
+
+    placeholders = jax.tree_util.tree_map_with_path(
+        _placeholder, qparams, is_leaf=_is_quant,
     )
 
     def lora_delta(key, x):
@@ -135,6 +170,15 @@ def qlora_fused_apply(
             return next_fn(*call_args, **call_kwargs)
         key = "/".join(mod.path) + "/kernel"
         t = quant.get(key)
+        if t is None and sideband:
+            # inside the scan body: the published value holds THIS
+            # layer's slices of the stacked quant leaves
+            from llm_in_practise_tpu.models.layers import (
+                current_scan_sideband,
+            )
+            sliced = current_scan_sideband()
+            if sliced is not None:
+                t = sliced.get(key)
         x = call_args[0]
         if t is None:
             # unquantized Dense: normal path, but a LoRA target must still
@@ -155,7 +199,7 @@ def qlora_fused_apply(
 
     with nn.intercept_methods(interceptor):
         out = model.apply({"params": placeholders}, *args, **apply_kwargs)
-    missed = set(quant) - consumed
+    missed = (set(quant) | set(sideband or ())) - consumed
     if missed:
         # an unconsumed quantized leaf means some module computed against
         # its (1, 1) placeholder — fail loudly at the source

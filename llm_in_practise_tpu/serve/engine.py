@@ -158,6 +158,14 @@ class InferenceEngine:
     ):
         self.model = model
         self.params = params
+        # Cache layout: which axis of each KV buffer indexes the slot.
+        # 0 = unrolled per-layer dicts (GPT/DeepSeek/unrolled Qwen3);
+        # 1 = stacked scan layout (axis 0 is the layer — Qwen3
+        # ``scan_layers``, whose init_cache wraps the stacked dict in a
+        # one-element list so both layouts iterate identically here).
+        # Width (sequence) axis is always slot_axis + 1.
+        self._sax = int(getattr(model, "cache_slot_axis", 0))
+        self._wax = self._sax + 1
         # Tensor-parallel serving (vLLM --tensor-parallel-size parity):
         # pass a mesh and params already placed by
         # :func:`shard_params_for_serving`; the KV cache shards its heads
@@ -303,8 +311,9 @@ class InferenceEngine:
             layer["index"] = jnp.zeros((self.max_slots,), jnp.int32)
 
     def _cache_shardings(self):
-        """KV heads ('k'/'v' buffers, dim 2) shard over the ``model`` axis;
-        everything else (latent MLA 'kv' buffers, indices) replicates."""
+        """KV heads ('k'/'v' buffers, second-to-last dim in either cache
+        layout) shard over the ``model`` axis; everything else (latent
+        MLA 'kv' buffers, indices) replicates."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from llm_in_practise_tpu.utils.tree import path_str
@@ -313,8 +322,10 @@ class InferenceEngine:
 
         def leaf(path, x):
             key = path_str(path).rsplit("/", 1)[-1]
-            if key in ("k", "v") and tp > 1 and x.shape[2] % tp == 0:
-                return NamedSharding(self.mesh, P(None, None, "model", None))
+            if key in ("k", "v") and tp > 1 and x.shape[-2] % tp == 0:
+                spec = [None] * x.ndim
+                spec[-2] = "model"
+                return NamedSharding(self.mesh, P(*spec))
             return NamedSharding(self.mesh, P())
 
         return jax.tree_util.tree_map_with_path(leaf, self.cache)
@@ -388,8 +399,7 @@ class InferenceEngine:
         )[:, 0, :]
         return last, cache
 
-    @staticmethod
-    def _primed(cache, prefix_rows, prefix_len):
+    def _primed(self, cache, prefix_rows, prefix_len):
         """Fresh 1-slot cache with prefix KV rows inserted, index offset."""
         primed = []
         for layer, rows in zip(cache, prefix_rows):
@@ -398,7 +408,7 @@ class InferenceEngine:
                 if key == "index":
                     continue
                 new[key] = jax.lax.dynamic_update_slice_in_dim(
-                    buf, rows[key].astype(buf.dtype), 0, axis=1
+                    buf, rows[key].astype(buf.dtype), 0, axis=self._wax
                 )
             primed.append(new)
         return primed
@@ -443,6 +453,16 @@ class InferenceEngine:
         )[:, 0, :]
         return last, fixed
 
+    def _slot_write(self, eng, rows, slot, width):
+        """Write ``rows`` (slot-axis size 1 or B) into ``eng`` at
+        ``slot`` (scalar or (B,) vector), first ``width`` positions of
+        the sequence axis — in either cache layout."""
+        rows = rows.astype(eng.dtype)
+        single = isinstance(slot, int)  # one slot: drop rows' slot axis
+        if self._sax == 0:
+            return eng.at[slot, :width].set(rows[0] if single else rows)
+        return eng.at[:, slot, :width].set(rows[:, 0] if single else rows)
+
     def _insert_fn(self, engine_cache, prefill_cache, slot: int, length):
         """Copy a prefilled request's cache rows into ``slot``. The
         prefill cache may be bucket-length (one-shot path) or full-length
@@ -454,8 +474,9 @@ class InferenceEngine:
                 if key == "index":
                     layer["index"] = eng["index"].at[slot].set(length)
                 else:
-                    width = pre[key].shape[1]
-                    layer[key] = eng[key].at[slot, :width].set(pre[key][0])
+                    width = pre[key].shape[self._wax]
+                    layer[key] = self._slot_write(
+                        eng[key], pre[key], slot, width)
             new.append(layer)
         return new
 
@@ -470,10 +491,9 @@ class InferenceEngine:
                 if key == "index":
                     layer["index"] = eng["index"].at[slot_ids].set(lengths)
                 else:
-                    width = pre[key].shape[1]
-                    layer[key] = eng[key].at[slot_ids, :width].set(
-                        pre[key].astype(eng[key].dtype)
-                    )
+                    width = pre[key].shape[self._wax]
+                    layer[key] = self._slot_write(
+                        eng[key], pre[key], slot_ids, width)
             new.append(layer)
         return new
 
@@ -486,10 +506,9 @@ class InferenceEngine:
                 if key == "index":
                     layer["index"] = eng["index"].at[slot].set(length)
                 else:
-                    bucket = layer_rows[key].shape[1]
-                    layer[key] = eng[key].at[slot, :bucket].set(
-                        layer_rows[key][0].astype(eng[key].dtype)
-                    )
+                    bucket = layer_rows[key].shape[self._wax]
+                    layer[key] = self._slot_write(
+                        eng[key], layer_rows[key], slot, bucket)
             new.append(layer)
         return new
 
@@ -600,9 +619,10 @@ class InferenceEngine:
                         [r.params.greedy for _, r, _ in part], bool),
                 ))
                 for j, (slot, req, plen) in enumerate(part):
+                    sl = (slice(None),) * self._sax + (slice(j, j + 1),)
                     self._store_prefix(
                         req, plen,
-                        [{k: v[j:j + 1] for k, v in layer.items()
+                        [{k: v[sl] for k, v in layer.items()
                           if k != "index"} for layer in pre],
                         last[j:j + 1])
                     self._activate_with_token(slot, req, plen, int(first[j]))
@@ -657,6 +677,11 @@ class InferenceEngine:
 
     def _lookup_prefix(self, req: Request, plen: int):
         def usable(entry) -> bool:
+            # rows from another engine (shared pool / restart) may be in
+            # the other cache layout — their shapes are transposed
+            # relative to this engine's writes and would scatter garbage
+            if getattr(entry, "slot_axis", 0) != self._sax:
+                return False
             # rows from another engine (shared pool) may be padded to a
             # bucket this engine's cache can't hold — the insert/suffix
             # scatters would clamp and corrupt the slot
@@ -677,9 +702,10 @@ class InferenceEngine:
         if hit is not None or self.kv_pool is None:
             return hit
         # L1 miss: cascade into the host/remote pool; a hit is promoted
-        # back into L1 so the hot set migrates toward HBM. ``usable`` only
-        # reads entry.length, so it filters host entries before the
-        # device upload (and remote entries before promotion).
+        # back into L1 so the hot set migrates toward HBM. ``usable``
+        # reads only entry metadata (length/bucket/slot_axis), so it
+        # filters host entries before the device upload (and remote
+        # entries before promotion).
         hit = self.kv_pool.lookup(req.prompt_ids, usable=usable)
         if hit is None:
             return None
@@ -762,8 +788,9 @@ class InferenceEngine:
         bucket = self._bucket_for(plen)
         entry = pc.PrefixEntry(
             length=plen, bucket=bucket,
-            rows=pc.slice_cache_rows(pre_cache, bucket),
+            rows=pc.slice_cache_rows(pre_cache, bucket, axis=self._wax),
             last_logits=last_logits,
+            slot_axis=self._sax,
         )
         self.prefix_cache.put(req.prompt_ids, entry)
         if self.kv_pool is not None and self.kv_pool.offload_on_put:

@@ -68,6 +68,7 @@ class HostEntry:
     bucket: int                 # padded length of the stored rows
     rows: list                  # per-layer {name: np.ndarray}
     last_logits: np.ndarray     # (1, vocab) logits at the final position
+    slot_axis: int = 0          # cache layout of the rows (PrefixEntry)
 
 
 def entry_to_host(entry) -> HostEntry:
@@ -81,6 +82,7 @@ def entry_to_host(entry) -> HostEntry:
         bucket=entry.bucket,
         rows=rows,
         last_logits=np.asarray(jax.device_get(entry.last_logits)),
+        slot_axis=getattr(entry, "slot_axis", 0),
     )
 
 
@@ -98,6 +100,7 @@ def entry_to_device(host: HostEntry):
         bucket=host.bucket,
         rows=rows,
         last_logits=jax.device_put(host.last_logits),
+        slot_axis=host.slot_axis,
     )
 
 
@@ -117,6 +120,7 @@ def encode_entry(host: HostEntry) -> bytes:
     manifest = {
         "length": host.length,
         "bucket": host.bucket,
+        "slot_axis": host.slot_axis,
         "rows": manifest_rows,
         "last_logits": {"shape": list(logits.shape),
                         "dtype": logits.dtype.name},
@@ -144,6 +148,7 @@ def decode_entry(blob: bytes) -> HostEntry:
     rows = [{name: take(meta) for name, meta in sorted(layer.items())}
             for layer in manifest["rows"]]
     return HostEntry(length=manifest["length"], bucket=manifest["bucket"],
+                     slot_axis=int(manifest.get("slot_axis", 0)),
                      rows=rows, last_logits=take(manifest["last_logits"]))
 
 

@@ -39,6 +39,12 @@ class PrefixEntry:
     bucket: int           # padded length of the stored rows
     rows: list            # per-layer {key: (1, bucket, ...) device array}
     last_logits: object   # (1, vocab) logits at the final prefix position
+    # Cache layout the rows were sliced from: the KV buffers' slot axis
+    # (0 = unrolled per-layer dicts, 1 = stacked scan layout). An engine
+    # must not consume rows from the other layout — the shapes are
+    # transposed relative to its writes (shared kv_pool / restart with
+    # the layout toggled) — so lookup filters on this.
+    slot_axis: int = 0
 
 
 class PrefixLRU:
@@ -167,13 +173,15 @@ class PrefixCache(PrefixLRU):
         return entry
 
 
-def slice_cache_rows(prefill_cache, bucket: int) -> list:
+def slice_cache_rows(prefill_cache, bucket: int, *, axis: int = 1) -> list:
     """Keep only the first ``bucket`` rows of each layer's KV buffers
-    (drop the per-layer index — the entry carries the true length)."""
+    (drop the per-layer index — the entry carries the true length).
+    ``axis`` is the sequence axis: 1 in the unrolled cache layout, 2 in
+    the stacked scan layout (engine passes its ``_wax``)."""
     rows = []
     for layer in prefill_cache:
         rows.append({
-            k: jax.lax.slice_in_dim(v, 0, bucket, axis=1)
+            k: jax.lax.slice_in_dim(v, 0, bucket, axis=axis)
             for k, v in layer.items() if k != "index"
         })
     return rows

@@ -51,6 +51,10 @@ class QuantizedModel:
     def config(self):
         return self.model.config
 
+    @property
+    def cache_slot_axis(self) -> int:
+        return getattr(self.model, "cache_slot_axis", 0)
+
     def init_cache(self, *args, **kwargs):
         return self.model.init_cache(*args, **kwargs)
 

@@ -85,12 +85,14 @@ def main() -> None:
 
     # warmup: compile prefill buckets (incl. the pow2 batched-admission
     # sizes up to max_slots), decode, and the capped block variants before
-    # timing anything — a saturating burst drives all of them
+    # timing anything — a saturating burst, then one mini-pass per ladder
+    # level so no first-use compile lands inside a timed level
     t0 = time.perf_counter()
     run_level_inprocess(engine, prompt_ids, concurrency=2 * MAX_SLOTS,
                         n_requests=3 * MAX_SLOTS, max_tokens=8)
-    run_level_inprocess(engine, prompt_ids, concurrency=8, n_requests=16,
-                        max_tokens=8)
+    for conc in LADDER:
+        run_level_inprocess(engine, prompt_ids, concurrency=conc,
+                            n_requests=max(8, conc), max_tokens=4)
     print(f"warmup/compile {time.perf_counter()-t0:.0f}s", flush=True)
 
     inproc_levels = []

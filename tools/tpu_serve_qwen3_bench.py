@@ -1,0 +1,175 @@
+"""Multi-billion-param serving ladder on the real TPU chip.
+
+Round 2's serving artifact measured a 36M GPTLike — fine for engine
+mechanics, useless for comparing against BASELINE.md's ladder, which
+serves Qwen3-8B. This tool serves a **Qwen3-architecture model with
+distinct-per-layer NF4 weights through the W4A16 fused-kernel path**
+(``serve/quantized.py``) on one chip, driving the engine directly
+(in-process — engine-attributable, no HTTP/tunnel transport in the
+timings) across a concurrency ladder.
+
+Reference counterpart: the vLLM W4A16 serving of quantized exports
+(``Quantization/LLM-Compressor/GPTQ/eval_qwen3_4b_gptq.py:11-21``) and
+the benchmark ladder methodology
+(``LLM_on_Kubernetes/Inference_Platfrom/README.md:1345-1520``).
+
+Knobs (env):
+
+- ``QWEN3_SERVE_GEOM``: ``small`` (d2048/L28 ≈ 1.72B, default) or ``8b``
+  (d4096/L36 GQA 32:8 — the real Qwen3-8B geometry, NF4 ≈ 4.4 GiB).
+- ``QWEN3_SERVE_SCAN`` (default 1): serve in the scan-layers layout —
+  stacked params AND stacked KV cache, every engine program compiling
+  ONE block regardless of depth; the packed NF4 components ride the
+  decode scan as sideband inputs (models/layers.py scan_sideband). This
+  is what makes the 36-layer model's engine compile in seconds through
+  the AOT service instead of tens of minutes.
+- ``QWEN3_SERVE_LAYERS``: override layer count within the geometry.
+
+Writes ``BENCH_SERVE_QWEN3_r03.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+from bench import _distinct_nf4_base, _hbm_stats
+from deploy.benchmark.bench_serve import PROMPTS, run_level_inprocess
+from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
+from llm_in_practise_tpu.quant.nf4 import tree_nbytes
+from llm_in_practise_tpu.serve.engine import InferenceEngine
+from llm_in_practise_tpu.serve.quantized import QuantizedModel
+
+OUT = os.path.join(REPO, "BENCH_SERVE_QWEN3_r03.json")
+LADDER = (4, 8, 16, 32)
+MAX_TOKENS = 64
+MAX_SLOTS = 16
+SLA = {"ttft_p99_ms": 2000.0, "tpot_p99_ms": 100.0}
+
+
+class ByteTokenizer:
+    def encode(self, text: str):
+        return list(text.encode("utf-8", errors="replace")[:256])
+
+    def decode(self, ids):
+        return bytes(int(i) % 256 for i in ids).decode(
+            "utf-8", errors="replace")
+
+
+GEOMS = {
+    "small": dict(hidden_size=2048, intermediate_size=6144, n_layer=28,
+                  n_head=16, n_kv_head=8, head_dim=128),
+    "8b": dict(hidden_size=4096, intermediate_size=12288, n_layer=36,
+               n_head=32, n_kv_head=8, head_dim=128),
+}
+
+
+def main() -> None:
+    geom = dict(GEOMS[os.environ.get("QWEN3_SERVE_GEOM", "small")])
+    if "QWEN3_SERVE_LAYERS" in os.environ:
+        geom["n_layer"] = int(os.environ["QWEN3_SERVE_LAYERS"])
+    use_scan = os.environ.get("QWEN3_SERVE_SCAN", "1") != "0"
+    n_layer = geom["n_layer"]
+    cfg = Qwen3Config(
+        vocab_size=151936, max_seq_len=1024, rope_theta=1e6,
+        tie_word_embeddings=True, remat=False, compute_dtype="bfloat16",
+        **geom,
+    )
+    print(f"quantizing distinct NF4 base (d{cfg.hidden_size}/L{n_layer}, "
+          f"scan={use_scan})...", flush=True)
+    qparams, quant_s = _distinct_nf4_base(cfg, Qwen3)
+    serve_cfg = cfg
+    if use_scan:
+        from llm_in_practise_tpu.models.qwen3 import stack_layer_params
+        qparams = jax.block_until_ready(
+            jax.jit(lambda t: stack_layer_params(t, n_layer),
+                    donate_argnums=0)(qparams))
+        serve_cfg = cfg.replace(scan_layers=True)
+    from llm_in_practise_tpu.peft.fused import _is_quant
+
+    nf4_bytes = tree_nbytes(qparams)
+    n_params = sum(
+        l.packed.size * 2 if _is_quant(l) else l.size
+        for l in jax.tree.leaves(qparams, is_leaf=_is_quant))
+    print(f"NF4 base {nf4_bytes/2**30:.2f} GiB in {quant_s:.0f}s | "
+          f"{_hbm_stats()}", flush=True)
+
+    decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
+    engine = InferenceEngine(
+        QuantizedModel(Qwen3(serve_cfg)), qparams, max_slots=MAX_SLOTS,
+        cache_len=1024, chunked_prefill=256, speculative_k=None,
+        decode_steps=decode_steps,
+    )
+    engine.start()
+    tok = ByteTokenizer()
+    prompt_ids = [tok.encode(p) for p in PROMPTS]
+    print(f"device {jax.devices()[0].device_kind} | slots {MAX_SLOTS} | "
+          f"decode_steps {decode_steps}", flush=True)
+
+    # Warmup compiles every program the timed ladder will hit: the
+    # saturating burst covers decode/chunked variants, then one mini-pass
+    # per ladder level covers each level's batched-admission sizes (pow2
+    # insert_batch programs) — without this, a first-use compile lands
+    # inside a timed level and reads as a 40 s TTFT outlier.
+    t0 = time.perf_counter()
+    run_level_inprocess(engine, prompt_ids, concurrency=2 * MAX_SLOTS,
+                        n_requests=2 * MAX_SLOTS, max_tokens=8)
+    for conc in LADDER:
+        run_level_inprocess(engine, prompt_ids, concurrency=conc,
+                            n_requests=max(8, conc), max_tokens=4)
+    print(f"warmup/compile {time.perf_counter()-t0:.0f}s | {_hbm_stats()}",
+          flush=True)
+
+    levels = []
+    for conc in LADDER:
+        r = run_level_inprocess(engine, prompt_ids, concurrency=conc,
+                                n_requests=max(32, 2 * conc),
+                                max_tokens=MAX_TOKENS)
+        r["sla_ok"] = (r["ttft_p99_ms"] < SLA["ttft_p99_ms"]
+                       and r["tpot_p99_ms"] < SLA["tpot_p99_ms"])
+        levels.append(r)
+        print(json.dumps(r), flush=True)
+
+    engine.stop()
+    artifact = {
+        "device": jax.devices()[0].device_kind,
+        "model": f"Qwen3-arch d{cfg.hidden_size}/L{n_layer}, vocab "
+                 f"151936, distinct-per-layer NF4 via fused W4A16 "
+                 f"kernels",
+        "layout": "scan (stacked params+KV, O(1)-depth compile)"
+                  if use_scan else "unrolled",
+        "nf4_base_bytes": int(nf4_bytes),
+        "approx_params": int(n_params),
+        "quantize_s": round(quant_s, 1),
+        "engine": {"max_slots": MAX_SLOTS, "cache_len": 1024,
+                   "chunked_prefill": 256, "decode_steps": decode_steps,
+                   "path": "serve/quantized.py fused NF4 Pallas kernels"},
+        "max_tokens": MAX_TOKENS,
+        "sla": SLA,
+        "levels_inprocess": levels,
+        **_hbm_stats(),
+        "reference_baseline": "BASELINE.md ladder (RTX 3090, Qwen3-8B "
+                              "W16, vLLM): 368.3 tok/s @ conc 8 — this "
+                              "run is a 1.7B-class W4 model; compare "
+                              "shapes and SLA behavior, not absolutes",
+        "environment_caveat": (
+            "axon remote-TPU tunnel: ~100-150 ms per device dispatch "
+            "inside every engine step; in-process timing excludes any "
+            "HTTP transport but not the tunnel. decode_steps amortizes "
+            "the dispatch over N tokens"
+        ),
+    }
+    with open(OUT, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
