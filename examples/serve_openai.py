@@ -81,6 +81,13 @@ def main():
                         "examples/quantize_ptq.py (weights stay packed in "
                         "HBM, fused dequant matmuls — vLLM "
                         "compressed-tensors serving parity)")
+    p.add_argument("--scan-layers", dest="scan_layers",
+                   action="store_true",
+                   help="serve in the scan-layers layout: params and KV "
+                        "cache stacked over depth, every engine program "
+                        "compiles ONE block — flat compile time for deep "
+                        "models (packed 4-bit weights ride the scan as "
+                        "sideband inputs); Qwen3-family only")
     args = p.parse_args()
 
     if args.quantized_dir and args.tp > 1:
@@ -89,6 +96,14 @@ def main():
     if args.quantized_dir and args.lora_modules:
         p.error("--lora-modules with --quantized_dir is not supported "
                 "(adapters cannot merge into packed 4-bit kernels)")
+    if args.scan_layers and args.tp > 1:
+        p.error("--scan-layers with --tensor-parallel-size is not "
+                "supported yet (stacked paths have no TP rules)")
+    if args.scan_layers and args.lora_modules:
+        p.error("--lora-modules with --scan-layers is not supported: "
+                "adapters merge by unrolled block_i/... kernel paths, "
+                "which do not exist in the stacked tree (they would "
+                "silently serve base weights)")
 
     tok = BPETokenizer.load(args.tokenizer_path)
     if args.quantized_dir:
@@ -112,6 +127,24 @@ def main():
         print(f"model: {args.model_path} | devices: {jax.devices()}")
 
     from llm_in_practise_tpu.data.sft import IM_END
+
+    if args.scan_layers:
+        from llm_in_practise_tpu.models.qwen3 import stack_layer_params
+        from llm_in_practise_tpu.serve.quantized import (
+            QuantizedModel as _QM,
+        )
+
+        inner = model.model if isinstance(model, _QM) else model
+        if not isinstance(inner, Qwen3):
+            p.error("--scan-layers requires a Qwen3-family model")
+        scfg = inner.cfg.replace(scan_layers=True)
+        params = jax.jit(
+            lambda t: stack_layer_params(t, scfg.n_layer),
+            donate_argnums=0)(params)
+        model = (_QM(Qwen3(scfg)) if isinstance(model, _QM)
+                 else Qwen3(scfg))
+        print(f"scan-layers serving: {scfg.n_layer} layers, "
+              "one compiled block per engine program")
 
     mesh = None
     shard_fn = None

@@ -401,7 +401,9 @@ class Qwen3(nn.Module):
                     new_caches.append(layer_cache)
         x = RMSNorm(cfg.rms_norm_eps, name="ln_f")(x)
         if return_hidden:
-            return x
+            # with a cache the refreshed cache must come back too, or the
+            # caller's KV writes are dead code and get eliminated
+            return (x, new_caches) if cache is not None else x
         if cfg.tie_word_embeddings:
             logits = embed.attend(x.astype(jnp.float32))
         else:

@@ -1,0 +1,136 @@
+"""Decode-step attribution at 8B scale: fused NF4 kernels vs XLA dequant.
+
+The 8B serving ladder (BENCH_SERVE_QWEN3_r03.json) measured ~140-157 ms
+TPOT at 16 slots. Weights-bound decode on paper is ~7 ms (4.5 GiB NF4 +
+1.2 GiB bf16 embed at ~800 GB/s), so something is ~18x off. Suspects:
+the fused NF4 Pallas kernel's thin-activation tiling at d4096, the f32
+151936-vocab lm_head, the scan overhead, and the ~120 ms/dispatch
+tunnel. This tool times a single 16-slot decode step through each path
+and shape variant and writes ``DECODE_AB_8B.json``:
+
+- fused kernels vs XLA dequant (``use_kernels``) — which serves better
+  at this scale decides ``QuantizedModel``'s default
+- with vs without the lm_head (``return_hidden=True``) — the head's share
+- decode_steps=8 multi-step to amortize the tunnel out of the numbers
+
+Run: ``python tools/tpu_decode_ab.py`` (env ``AB_GEOM=small|8b``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from bench import _distinct_nf4_base
+from llm_in_practise_tpu.models.qwen3 import (
+    Qwen3, Qwen3Config, stack_layer_params,
+)
+from llm_in_practise_tpu.peft.fused import fused_quant_apply
+
+OUT = os.path.join(REPO, "DECODE_AB_8B.json")
+GEOMS = {
+    "small": dict(hidden_size=2048, intermediate_size=6144, n_layer=28,
+                  n_head=16, n_kv_head=8, head_dim=128),
+    "8b": dict(hidden_size=4096, intermediate_size=12288, n_layer=36,
+               n_head=32, n_kv_head=8, head_dim=128),
+}
+SLOTS = 16
+STEPS = 8
+
+
+def timeit(fn, n=5):
+    jax.block_until_ready(fn())  # compile
+    jax.block_until_ready(fn())  # warm — retire before the clock starts
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> None:
+    geom = GEOMS[os.environ.get("AB_GEOM", "8b")]
+    cfg = Qwen3Config(
+        vocab_size=151936, max_seq_len=1024, rope_theta=1e6,
+        tie_word_embeddings=True, remat=False, compute_dtype="bfloat16",
+        scan_layers=True, **geom,
+    )
+    print("quantizing...", flush=True)
+    qu, qs_sec = _distinct_nf4_base(cfg.replace(scan_layers=False), Qwen3)
+    qparams = jax.block_until_ready(jax.jit(
+        lambda t: stack_layer_params(t, cfg.n_layer), donate_argnums=0)(qu))
+    model = Qwen3(cfg)
+    cache0 = model.init_cache(SLOTS, 1024, dtype=jnp.bfloat16)
+    cache0[0]["index"] = jnp.full((SLOTS,), 64, jnp.int32)
+    tok = jnp.ones((SLOTS, 1), jnp.int32)
+    results = {"geom": geom, "slots": SLOTS, "quantize_s": round(qs_sec, 1)}
+
+    def decode_path(use_kernels, head):
+        def step(qp, cache):
+            kw = {} if head else {"return_hidden": True}
+            # both variants return (out, new_cache): the KV writes stay
+            # live in the no-head variant instead of being DCE'd, so the
+            # full-vs-no-head delta isolates the lm_head alone
+            return fused_quant_apply(
+                model, qp, tok, compute_dtype=jnp.bfloat16,
+                use_kernels=use_kernels, cache=cache, **kw)
+
+        f = jax.jit(step)
+        return lambda: f(qparams, cache0)
+
+    def multi_step(use_kernels):
+        def run(qp, cache, t):
+            def body(carry, _):
+                tt, c = carry
+                logits, c = fused_quant_apply(
+                    model, qp, tt, compute_dtype=jnp.bfloat16,
+                    use_kernels=use_kernels, cache=c)
+                nt = jnp.argmax(
+                    logits[:, -1].astype(jnp.float32), -1
+                )[:, None].astype(jnp.int32)
+                return (nt, c), nt
+            (_, cache), toks = jax.lax.scan(
+                body, (t, cache), None, length=STEPS)
+            return toks
+        f = jax.jit(run)
+        return lambda: f(qparams, cache0, tok)
+
+    for name, fn in [
+        ("fused_full", decode_path(True, head=True)),
+        ("fused_no_head", decode_path(True, head=False)),
+        ("xla_full", decode_path(False, head=True)),
+        ("xla_no_head", decode_path(False, head=False)),
+    ]:
+        try:
+            dt = timeit(fn)
+            results[name + "_ms"] = round(dt * 1e3, 1)
+            print(f"{name}: {dt*1e3:.1f} ms/step", flush=True)
+        except Exception as e:  # record, keep going
+            results[name + "_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            print(f"{name}: FAILED {e}", flush=True)
+
+    for name, k in [("fused_multi8", True), ("xla_multi8", False)]:
+        try:
+            dt = timeit(multi_step(k), n=3)
+            results[name + "_ms_per_tok"] = round(dt * 1e3 / STEPS, 1)
+            print(f"{name}: {dt*1e3/STEPS:.1f} ms/token "
+                  f"({dt*1e3:.0f} ms / {STEPS} steps)", flush=True)
+        except Exception as e:
+            results[name + "_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            print(f"{name}: FAILED {e}", flush=True)
+
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=2)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -124,8 +124,8 @@ def main() -> None:
     for conc in LADDER:
         run_level_inprocess(engine, prompt_ids, concurrency=conc,
                             n_requests=max(8, conc), max_tokens=4)
-    print(f"warmup/compile {time.perf_counter()-t0:.0f}s | {_hbm_stats()}",
-          flush=True)
+    warmup_s = time.perf_counter() - t0
+    print(f"warmup/compile {warmup_s:.0f}s | {_hbm_stats()}", flush=True)
 
     levels = []
     for conc in LADDER:
@@ -148,6 +148,7 @@ def main() -> None:
         "nf4_base_bytes": int(nf4_bytes),
         "approx_params": int(n_params),
         "quantize_s": round(quant_s, 1),
+        "warmup_compile_s": round(warmup_s, 1),
         "engine": {"max_slots": MAX_SLOTS, "cache_len": 1024,
                    "chunked_prefill": 256, "decode_steps": decode_steps,
                    "path": "serve/quantized.py fused NF4 Pallas kernels"},
@@ -155,10 +156,13 @@ def main() -> None:
         "sla": SLA,
         "levels_inprocess": levels,
         **_hbm_stats(),
-        "reference_baseline": "BASELINE.md ladder (RTX 3090, Qwen3-8B "
-                              "W16, vLLM): 368.3 tok/s @ conc 8 — this "
-                              "run is a 1.7B-class W4 model; compare "
-                              "shapes and SLA behavior, not absolutes",
+        "reference_baseline": (
+            "BASELINE.md ladder (RTX 3090, Qwen3-8B W16, vLLM): 368.3 "
+            f"tok/s @ conc 8 — this run is a "
+            f"{n_params/1e9:.1f}B-class W4 (NF4) model on one 16 GB "
+            "v5e; W4 decode at this scale is dequant-bound "
+            "(DECODE_AB_8B.json), so compare shapes and SLA behavior, "
+            "not absolutes"),
         "environment_caveat": (
             "axon remote-TPU tunnel: ~100-150 ms per device dispatch "
             "inside every engine step; in-process timing excludes any "
