@@ -256,18 +256,25 @@ class Qwen3Block(nn.Module):
 
 class _ScanBody(nn.Module):
     """One scan step: positional-only signature for ``nn.scan`` (carry = the
-    hidden stream; rope tables and positions ride as broadcast inputs)."""
+    hidden stream; rope tables and positions ride as broadcast inputs).
+    ``sideband`` (scanned, may be None) is this layer's slice of
+    caller-provided side inputs — stacked packed quantized weights and/or
+    stacked LoRA factors — published via :func:`..layers.scan_sideband`
+    so method interceptors (peft/fused.py) can serve the current layer's
+    tensors; gradients flow through it (it is ordinary scanned ``xs``),
+    which is what makes full-depth QLoRA training under scan work."""
 
     cfg: Qwen3Config
 
     @nn.compact
-    def __call__(self, x, rope_tables, positions):
+    def __call__(self, x, sideband, rope_tables, positions):
         block_cls = (
             nn.remat(Qwen3Block, prevent_cse=False)
             if self.cfg.remat else Qwen3Block
         )
-        x, _ = block_cls(self.cfg, name="block")(
-            x, rope_tables, cache=None, positions=positions)
+        with layers.scan_sideband(sideband):
+            x, _ = block_cls(self.cfg, name="block")(
+                x, rope_tables, cache=None, positions=positions)
         return x, None
 
 
@@ -327,20 +334,20 @@ class Qwen3(nn.Module):
         cache: list[Cache] | None = None,
         positions: jax.Array | None = None,
         return_hidden: bool = False,  # final-norm hidden states (embedder use)
-        # Per-layer side inputs for the scan-decode path (leading n_layer
-        # axis; e.g. stacked packed quantized weights) — scanned alongside
-        # the KV slices and published to interceptors via the
-        # layers.scan_sideband channel. Only valid with scan_layers+cache.
+        # Per-layer side inputs for the scan paths (leading n_layer axis;
+        # e.g. stacked packed quantized weights, stacked LoRA factors) —
+        # scanned alongside each layer's slice and published to
+        # interceptors via the layers.scan_sideband channel. Training
+        # scan and cached-decode scan both thread it; requires
+        # scan_layers=True.
         scan_sideband: Any = None,
     ):
         cfg = self.cfg
         compute_dtype = jnp.dtype(cfg.compute_dtype)
-        if scan_sideband is not None and not (
-            cfg.scan_layers and cache is not None
-        ):
+        if scan_sideband is not None and not cfg.scan_layers:
             raise ValueError(
-                "scan_sideband is only consumed by the scan-layers cached "
-                "decode path (scan_layers=True with a cache)")
+                "scan_sideband is only consumed by the scan-layers paths "
+                "(set scan_layers=True)")
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size,
             embedding_init=nn.initializers.normal(0.02), name="tok_embed",
@@ -377,10 +384,11 @@ class Qwen3(nn.Module):
                     _ScanBody,
                     variable_axes={"params": 0},
                     split_rngs={"params": True, "dropout": True},
-                    in_axes=(nn.broadcast, nn.broadcast),
+                    in_axes=(0, nn.broadcast, nn.broadcast),
                     length=cfg.n_layer,
                 )
-                x, _ = scan(cfg, name="blocks")(x, rope_tables, positions)
+                x, _ = scan(cfg, name="blocks")(
+                    x, scan_sideband, rope_tables, positions)
         else:
             for i in range(cfg.n_layer):
                 layer_cache = cache[i] if cache is not None else None

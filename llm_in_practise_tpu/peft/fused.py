@@ -113,29 +113,31 @@ def qlora_fused_apply(
     # init_lora's tree is already keyed by kernel path: {path: {"a", "b"}}
     lora_by_path: dict[str, dict] = lora_params or {}
 
-    # Scan-layers serving: block quant leaves live STACKED under
-    # "blocks/block/..." (leading n_layer axis per component). They can't
-    # be served from this closure — inside the scan the interceptor needs
-    # the CURRENT layer's slice, which only exists as the body's scanned
-    # input. Route them through the model's scan_sideband channel (the
-    # body publishes its slice via layers.scan_sideband; the interceptor
-    # reads layers.current_scan_sideband). Keys match module paths
-    # exactly because the sideband dict is keyed the same way.
+    # Scan-layers models: block quant leaves AND block LoRA factors live
+    # STACKED under "blocks/block/..." (leading n_layer axis per
+    # component). They can't be served from this closure — inside the
+    # scan the interceptor needs the CURRENT layer's slice, which only
+    # exists as the body's scanned input. Route them through the model's
+    # scan_sideband channel as {"q": {path: quant}, "l": {path: {a, b}}}
+    # (the body publishes its slice via layers.scan_sideband; the
+    # interceptor reads layers.current_scan_sideband). Keys match module
+    # paths exactly. Gradients flow through "l" — sideband entries are
+    # ordinary scanned xs — which is what makes full-depth QLoRA
+    # training under scan differentiable.
     scan_mode = bool(getattr(getattr(model, "config", None) or
                              getattr(model, "cfg", None),
                              "scan_layers", False))
     sideband = None
     if scan_mode:
-        sideband = {k: v for k, v in quant.items()
-                    if k.startswith("blocks/block/")}
-        if sideband:
-            if apply_kwargs.get("cache") is None:
-                raise NotImplementedError(
-                    "fused serving of a scan-layers quant tree runs "
-                    "through the cached-decode scan (the training scan "
-                    "body has no sideband); pass a cache, or unstack "
-                    "with unstack_layer_params + scan_layers=False")
-            quant = {k: v for k, v in quant.items() if k not in sideband}
+        q_side = {k: v for k, v in quant.items()
+                  if k.startswith("blocks/block/")}
+        l_side = {k: v for k, v in lora_by_path.items()
+                  if k.startswith("blocks/block/")}
+        if q_side or l_side:
+            sideband = {"q": q_side, "l": l_side}
+            quant = {k: v for k, v in quant.items() if k not in q_side}
+            lora_by_path = {k: v for k, v in lora_by_path.items()
+                            if k not in l_side}
             apply_kwargs = dict(apply_kwargs, scan_sideband=sideband)
     n_layer = getattr(getattr(model, "config", None) or
                       getattr(model, "cfg", None), "n_layer", None)
@@ -148,7 +150,7 @@ def qlora_fused_apply(
         if not _is_quant(v):
             return v
         from llm_in_practise_tpu.utils.tree import path_str
-        if sideband and path_str(path) in sideband:
+        if sideband and path_str(path) in sideband["q"]:
             return jnp.zeros((n_layer, 1, 1), compute_dtype)
         return jnp.zeros((1, 1), compute_dtype)
 
@@ -158,6 +160,13 @@ def qlora_fused_apply(
 
     def lora_delta(key, x):
         lp = lora_by_path.get(key)
+        if lp is None and sideband:
+            from llm_in_practise_tpu.models.layers import (
+                current_scan_sideband,
+            )
+            sliced = current_scan_sideband()
+            if sliced is not None:
+                lp = sliced["l"].get(key)
         if lp is None:
             return None
         a = lp["a"].astype(compute_dtype)
@@ -178,7 +187,7 @@ def qlora_fused_apply(
             )
             sliced = current_scan_sideband()
             if sliced is not None:
-                t = sliced.get(key)
+                t = sliced["q"].get(key)
         x = call_args[0]
         if t is None:
             # unquantized Dense: normal path, but a LoRA target must still
@@ -199,7 +208,8 @@ def qlora_fused_apply(
 
     with nn.intercept_methods(interceptor):
         out = model.apply({"params": placeholders}, *args, **apply_kwargs)
-    missed = (set(quant) | set(sideband or ())) - consumed
+    missed = (set(quant)
+              | (set(sideband["q"]) if sideband else set())) - consumed
     if missed:
         # an unconsumed quantized leaf means some module computed against
         # its (1, 1) placeholder — fail loudly at the source
@@ -229,6 +239,43 @@ def make_fused_qlora_loss_fn(model, qparams, cfg: lora_lib.LoRAConfig,
             )
 
         return base_loss_fn(apply_out, batch, rng)
+
+    return loss_fn
+
+
+def make_fused_qlora_loss_fn_args(model, cfg: lora_lib.LoRAConfig,
+                                  base_loss_fn,
+                                  compute_dtype=jnp.bfloat16,
+                                  use_kernels: bool = False):
+    """Args-passing form of :func:`make_fused_qlora_loss_fn`:
+    ``loss(lora_params, qparams, batch, rng)`` with the frozen base as a
+    jit ARGUMENT (the closure form bakes multi-GB constants into the
+    serialized program — docs/perf.md Finding 6).
+
+    The default ``use_kernels=False`` runs every quantized Dense through
+    :func:`xla_dequant_matmul`: the compiler dequantizes each kernel AT
+    ITS USE SITE and frees it, so peak memory is the packed tree plus
+    one layer's bf16 transient — unlike
+    :func:`..peft.qlora.make_qlora_loss_fn_args`, whose ``qlora_apply``
+    materializes the ENTIRE bf16 base before the forward (≈ 2 bytes/param
+    extra; a 7.6B base is 15 GiB, more than a v5e chip). This is the
+    builder that makes full-depth multi-B QLoRA steps fit on one chip;
+    the price is re-dequantizing in the backward's remat recompute.
+
+    ``base_loss_fn(apply_out, qparams, batch, rng)``: ``apply_out``
+    forwards to ``model.apply`` through the interceptor; ``qparams`` is
+    passed along for non-quantized leaves the loss needs directly (the
+    bf16 embedding for a fused tied-head cross-entropy)."""
+
+    def loss_fn(lora_params, qparams, batch, rng):
+        def apply_out(*args, **kw):
+            return qlora_fused_apply(
+                model, qparams, lora_params, cfg, *args,
+                compute_dtype=compute_dtype, use_kernels=use_kernels,
+                **kw,
+            )
+
+        return base_loss_fn(apply_out, qparams, batch, rng)
 
     return loss_fn
 

@@ -90,6 +90,34 @@ def init_lora(
     return tree
 
 
+def stack_lora_tree(lora_params: dict, n_layer: int) -> dict:
+    """Unrolled flat-path LoRA tree -> the scan layout: every
+    ``block_{i}/rest`` entry stacks into one ``blocks/block/rest`` entry
+    whose ``a``/``b`` gain a leading ``n_layer`` axis (matching
+    ``models.qwen3.stack_layer_params`` for the base). Non-block entries
+    pass through. Use when converting an adapter trained on the unrolled
+    layout for scan-layers training/serving."""
+    out: dict = {}
+    grouped: dict[str, dict[int, dict]] = {}
+    for path, ab in lora_params.items():
+        m = re.match(r"block_(\d+)/(.*)", path)
+        if not m:
+            out[path] = ab
+            continue
+        grouped.setdefault(m.group(2), {})[int(m.group(1))] = ab
+    for rest, by_layer in grouped.items():
+        if sorted(by_layer) != list(range(n_layer)):
+            raise ValueError(
+                f"LoRA target {rest!r} present in layers "
+                f"{sorted(by_layer)} but stacking needs all "
+                f"{n_layer} — scan layers share one program, so every "
+                "layer must carry the adapter")
+        out[f"blocks/block/{rest}"] = jax.tree.map(
+            lambda *ls: jnp.stack(ls, axis=0),
+            *[by_layer[i] for i in range(n_layer)])
+    return out
+
+
 def apply_lora(params, lora_params: dict, cfg: LoRAConfig):
     """Effective param tree: target kernels become ``W + scaling·A@B``.
 

@@ -162,13 +162,20 @@ def test_prefix_entries_layout_tagged(models):
     assert any(h.slot_axis == 1 for h in pool._entries.values())
 
 
-def test_quantized_scan_no_cache_raises(models):
+def test_quantized_scan_no_cache_forward(models):
+    """Cache-less quantized forward under scan (the TRAINING scan path,
+    whose sideband now carries the packed weights): logits equal the
+    unrolled quantized forward."""
     from llm_in_practise_tpu.peft.qlora import quantize_base
     from llm_in_practise_tpu.serve.quantized import QuantizedModel
 
     mu, pu, ms, _ = models
-    qs = stack_layer_params(quantize_base(pu), mu.cfg.n_layer)
-    qmodel = QuantizedModel(ms, compute_dtype=jnp.float32,
-                            use_kernels=False)
-    with pytest.raises(NotImplementedError):
-        qmodel.apply({"params": qs}, jnp.ones((1, 4), jnp.int32))
+    qu = quantize_base(pu)
+    qs = stack_layer_params(qu, mu.cfg.n_layer)
+    x = jnp.ones((1, 4), jnp.int32)
+    a = QuantizedModel(mu, compute_dtype=jnp.float32,
+                       use_kernels=False).apply({"params": qu}, x)
+    b = QuantizedModel(ms, compute_dtype=jnp.float32,
+                       use_kernels=False).apply({"params": qs}, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-4)

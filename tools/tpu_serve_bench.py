@@ -110,6 +110,21 @@ def main() -> None:
     url = f"http://127.0.0.1:{port}"
     print(f"server on {url}", flush=True)
 
+    # HTTP-side warmup: the chat prompt builder wraps prompts in ChatML,
+    # landing them in LONGER prefill buckets than the raw in-process
+    # prompt ids — without this, those buckets compile inside the first
+    # timed HTTP level and read as 20 s+ TTFT outliers. Deterministic
+    # coverage: hit EVERY prompt once (run_level samples randomly and
+    # can miss one), then a concurrent pass for the batched variants.
+    from deploy.benchmark.bench_serve import one_request
+
+    t0 = time.perf_counter()
+    for p in PROMPTS:
+        one_request(url, "gptlike-tpu", p, max_tokens=4, timeout=600)
+    run_level(url, "gptlike-tpu", concurrency=8,
+              n_requests=2 * len(PROMPTS), max_tokens=4, timeout=600)
+    print(f"http warmup {time.perf_counter()-t0:.0f}s", flush=True)
+
     http_levels = []
     for conc in LADDER:
         r = run_level(url, "gptlike-tpu", concurrency=conc,
