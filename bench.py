@@ -106,6 +106,82 @@ def flops_per_token(m: int, n_layer: int, seq: int, dim: int,
     return 2.0 * matmul_fwd + 3.0 * attn_fwd
 
 
+SEQ = 1024  # training sequence length for every QLoRA rung
+
+
+def _measure_batches(qstep, qparams, lora_host, opt_host, batches,
+                     vocab: int, errors: list, tag: str):
+    """ONE measurement protocol for every QLoRA rung (scan primary AND
+    materialized fallback — a protocol tweak here changes both): per
+    batch size, fresh DONATED lora/opt state restored from host copies
+    (a failed rung consumes the donated buffers), WARMUP steps, then
+    best-of-3 8-iteration windows. Returns (batch_size, sec/step) for
+    the first batch that runs, else None; failures append to
+    ``errors``."""
+    import gc
+
+    rng = np.random.default_rng(0)
+    key = jax.random.PRNGKey(2)
+    for batch_size in batches:
+        try:
+            state = None
+            gc.collect()
+            x = jnp.asarray(
+                rng.integers(0, vocab, (batch_size, SEQ)), jnp.int32)
+            batch = (x, jnp.roll(x, -1, axis=1))
+            state = {"lora": jax.device_put(lora_host),
+                     "opt": jax.device_put(opt_host)}
+
+            def one_step():
+                state["lora"], state["opt"], loss = qstep(
+                    state["lora"], state["opt"], qparams, batch, key)
+                return loss
+
+            for _ in range(WARMUP):
+                one_step()
+            return batch_size, timed_window(one_step, n_iters=8,
+                                            n_windows=3)
+        except Exception as e:
+            errors.append(f"{tag} batch {batch_size}: "
+                          f"{type(e).__name__}: {str(e)[:300]}")
+            _progress("FAILED " + errors[-1][:400])
+            # NOTE: helper HTTP 500s are often compile-time OOM (memory
+            # assignment), which IS batch-dependent — keep trying
+            # smaller batches
+    return None
+
+
+def _qlora_report(*, peak, f_tok, batch_size, dt, n_total, nf4_bytes,
+                  quant_s, model_desc, check_tag, **extra) -> dict:
+    """Assemble the rung report (shared by both rung kinds): throughput,
+    MFU (gated to (0, 1]), and the audited estimated-A100 derivation."""
+    tokens = batch_size * SEQ
+    tok_s = tokens / dt
+    mfu = f_tok * tokens / dt / peak
+    check_mfu(check_tag, mfu)
+    a100_est = A100_PEAK * A100_MFU_EST / f_tok
+    return {
+        "model": model_desc,
+        "params_total": n_total,
+        "distinct_blocks": True,
+        "batch": batch_size, "seq": SEQ,
+        "tokens_per_sec_per_chip": round(tok_s, 1),
+        "mfu": round(mfu, 4),
+        "flops_per_token": f_tok,
+        "nf4_base_bytes": int(nf4_bytes),
+        "quantize_base_lowmem_s": round(quant_s, 1),
+        "a100_est_tok_s": round(a100_est, 1),
+        "a100_derivation":
+            f"{A100_PEAK/1e12:.0f}e12 * {A100_MFU_EST} "
+            f"/ {f_tok:.3g} (ESTIMATED denominator: no measured A100 "
+            "run exists for this workload)",
+        "vs_a100_est": round(tok_s / a100_est, 3),
+        "north_star_met_estimated(>=0.5)": tok_s / a100_est >= 0.5,
+        **_hbm_stats(),
+        **extra,
+    }
+
+
 def timed_window(step_fn, n_iters: int, n_windows: int = 2) -> float:
     """Best-of-N windows; each window's completion is forced by pulling the
     loss value to host. Returns seconds/step."""
@@ -221,7 +297,6 @@ def _qlora_ladder(peak: float, shapes: list,
 
     import gc
 
-    SEQ = 1024
     # Provable-skip bound: this path materializes the full bf16 base
     # (qlora_apply) next to the packed NF4 tree, ≈ 2.55 bytes/param
     # before activations. Rungs over the chip's HBM at batch 1 can never
@@ -312,72 +387,26 @@ def _qlora_ladder(peak: float, shapes: list,
             f_tok = flops_per_token(m, cfg.n_layer, SEQ,
                                     cfg.n_head * cfg.head_dim,
                                     train_full=False)
-            rng = np.random.default_rng(0)
-            # host copies: a failed run may have consumed the DONATED
-            # lora/opt buffers, so every batch rung restores fresh ones
-            lora_host = jax.device_get(lora)
-            opt_host = jax.device_get(opt_state)
             # per-shape batch ladder: a failed rung costs the driver
             # minutes of compile, so each starts at its proven point
-            for batch_size in batches:
-                try:
-                    state = None
-                    gc.collect()
-                    x = jnp.asarray(
-                        rng.integers(0, cfg.vocab_size, (batch_size, SEQ)),
-                        jnp.int32)
-                    batch = (x, jnp.roll(x, -1, axis=1))
-                    key = jax.random.PRNGKey(2)
-                    state = {"lora": jax.device_put(lora_host),
-                             "opt": jax.device_put(opt_host)}
-
-                    def one_step():
-                        state["lora"], state["opt"], loss = qstep(
-                            state["lora"], state["opt"], qparams, batch,
-                            key)
-                        return loss
-
-                    for _ in range(WARMUP):
-                        one_step()
-                    dt = timed_window(one_step, n_iters=8, n_windows=3)
-                    tokens = batch_size * SEQ
-                    tok_s = tokens / dt
-                    mfu = f_tok * tokens / dt / peak
-                    check_mfu("qlora", mfu)
-                    a100_est = A100_PEAK * A100_MFU_EST / f_tok
-                    return {
-                        "ladder_errors": errors[:8],
-                        "tokens_per_sec_per_chip": round(tok_s, 1),
-                        "mfu": round(mfu, 4),
-                        "model": f"qwen3-arch {n_total/1e9:.2f}B "
-                                 f"(L{cfg.n_layer}/d{cfg.hidden_size}, "
-                                 f"vocab {vocab} — see bench_qlora "
-                                 "docstring)",
-                        "params_total": n_total,
-                        "distinct_blocks": True,
-                        "nf4_base_bytes": int(nf4_bytes),
-                        "quantize_base_lowmem_s": round(quant_s, 1),
-                        **_hbm_stats(),
-                        "batch": batch_size, "seq": SEQ,
-                        "flops_per_token": f_tok,
-                        "a100_est_tok_s": round(a100_est, 1),
-                        "a100_derivation":
-                            f"{A100_PEAK/1e12:.0f}e12 * {A100_MFU_EST} "
-                            f"/ {f_tok:.3g} (ESTIMATED denominator: no "
-                            "measured A100 run exists for this workload)",
-                        "vs_a100_est": round(tok_s / a100_est, 3),
-                        "north_star_met_estimated(>=0.5)":
-                            tok_s / a100_est >= 0.5,
-                    }, errors
-                except Exception as e:
-                    errors.append(
-                        f"qlora d{shape['hidden_size']}/L{shape['n_layer']}"
-                        f"/v{vocab} batch {batch_size}: "
-                        f"{type(e).__name__}: {str(e)[:300]}")
-                    _progress("FAILED " + errors[-1][:400])
-                    # NOTE: helper HTTP 500s are often compile-time OOM
-                    # (memory assignment), which IS batch-dependent — so
-                    # the ladder keeps trying smaller batches
+            hit = _measure_batches(
+                qstep, qparams, jax.device_get(lora),
+                jax.device_get(opt_state), batches, cfg.vocab_size,
+                errors,
+                f"qlora d{shape['hidden_size']}/L{shape['n_layer']}"
+                f"/v{vocab}")
+            if hit is not None:
+                batch_size, dt = hit
+                return _qlora_report(
+                    peak=peak, f_tok=f_tok, batch_size=batch_size,
+                    dt=dt, n_total=n_total, nf4_bytes=nf4_bytes,
+                    quant_s=quant_s, check_tag="qlora",
+                    model_desc=f"qwen3-arch {n_total/1e9:.2f}B "
+                               f"(L{cfg.n_layer}/d{cfg.hidden_size}, "
+                               f"vocab {vocab} — see bench_qlora "
+                               "docstring)",
+                    ladder_errors=errors[:8],
+                ), errors
         except Exception as e:
             errors.append(
                 f"qlora shape d{shape['hidden_size']}/L{shape['n_layer']}"
@@ -413,11 +442,12 @@ def bench_qlora(peak: float) -> dict:
     block_cache: dict = {}
     # Primary attempt: the REAL full-depth 8B geometry, trained under
     # the scan with inline dequant (measured on this chip: 7.57B at
-    # batch 2 → 1,976 tok/s, 31.3% MFU, ratio 0.56 — the north-star
-    # workload at its true scale, no depth proxy at all).
+    # batch 16 → 2,119 tok/s, 33.5% MFU, ratio 0.61 — the north-star
+    # workload at its true scale, no depth proxy at all; batches 2→16
+    # measured within 7% of each other, the dequant tax dominating).
     _progress("full-depth L36 scan rung (inline dequant)...")
     result, scan_errors = _fused_scale_proof(
-        peak, dict(vocab=151936, n_layer=36, batches=(4, 2), **G8B),
+        peak, dict(vocab=151936, n_layer=36, batches=(16, 8, 4, 2), **G8B),
         block_cache)
     if result is not None:
         result["ladder_errors"] = scan_errors[:8]
@@ -461,8 +491,6 @@ def _fused_scale_proof(peak: float, shape: dict,
     and the program is O(1) in depth. Slower per token (the backward's
     remat recompute re-dequantizes) — which is why it is the scale
     PROOF, not the throughput headline."""
-    import gc
-
     from llm_in_practise_tpu.models.qwen3 import (
         Qwen3, Qwen3Config, stack_layer_params,
     )
@@ -471,7 +499,6 @@ def _fused_scale_proof(peak: float, shape: dict,
     from llm_in_practise_tpu.quant.nf4 import tree_nbytes
     from llm_in_practise_tpu.train.losses import fused_linear_cross_entropy
 
-    SEQ = 1024
     errors: list[str] = []
     shape = dict(shape)
     batches = shape.pop("batches")
@@ -515,9 +542,6 @@ def _fused_scale_proof(peak: float, shape: dict,
 
         loss_fn = make_fused_qlora_loss_fn_args(model, lcfg, base_loss)
         tx = optax.adamw(1e-4)
-        lora_host = jax.device_get(lora)
-        opt_host = jax.device_get(tx.init(lora))
-        rng = np.random.default_rng(0)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def qstep(lora, opt_state, qp, batch, rng):
@@ -525,63 +549,23 @@ def _fused_scale_proof(peak: float, shape: dict,
             updates, opt_state = tx.update(grads, opt_state, lora)
             return optax.apply_updates(lora, updates), opt_state, loss
 
-        # NOTE: the measurement protocol below (fresh donated state from
-        # host copies per batch rung, WARMUP + timed_window) mirrors
-        # _qlora_ladder's rung body — keep the two in sync
-        key = jax.random.PRNGKey(2)
-        for batch_size in batches:
-            try:
-                state = None
-                gc.collect()  # a failed rung's donated buffers
-                x = jnp.asarray(
-                    rng.integers(0, vocab, (batch_size, SEQ)), jnp.int32)
-                batch = (x, jnp.roll(x, -1, axis=1))
-                state = {"lora": jax.device_put(lora_host),
-                         "opt": jax.device_put(opt_host)}
-
-                def one_step():
-                    state["lora"], state["opt"], loss = qstep(
-                        state["lora"], state["opt"], qparams, batch, key)
-                    return loss
-
-                for _ in range(WARMUP):
-                    one_step()
-                dt = timed_window(one_step, n_iters=4, n_windows=2)
-                tokens = batch_size * SEQ
-                tok_s = tokens / dt
-                mfu = f_tok * tokens / dt / peak
-                check_mfu("scale_proof", mfu)
-                a100_est = A100_PEAK * A100_MFU_EST / f_tok
-                return {
-                    "mode": "train_step_scan_inline_dequant",
-                    "model": f"qwen3-arch {n_total/1e9:.2f}B "
-                             f"(L{cfg.n_layer}/d{cfg.hidden_size}, "
-                             f"vocab {vocab})",
-                    "params_total": n_total,
-                    "distinct_blocks": True,
-                    "batch": batch_size, "seq": SEQ,
-                    "tokens_per_sec_per_chip": round(tok_s, 1),
-                    "mfu": round(mfu, 4),
-                    "flops_per_token": f_tok,
-                    "nf4_base_bytes": int(tree_nbytes(qparams)),
-                    "quantize_base_lowmem_s": round(quant_s, 1),
-                    "a100_est_tok_s": round(a100_est, 1),
-                    "a100_derivation":
-                        f"{A100_PEAK/1e12:.0f}e12 * {A100_MFU_EST} "
-                        f"/ {f_tok:.3g} (ESTIMATED denominator: no "
-                        "measured A100 run exists for this workload)",
-                    "vs_a100_est": round(tok_s / a100_est, 3),
-                    "north_star_met_estimated(>=0.5)":
-                        tok_s / a100_est >= 0.5,
-                    **_hbm_stats(),
-                }, errors
-            except Exception as e:
-                errors.append(
-                    f"scale proof batch {batch_size}: "
-                    f"{type(e).__name__}: {str(e)[:300]}")
-                _progress("FAILED " + errors[-1][:400])
+        hit = _measure_batches(
+            qstep, qparams, jax.device_get(lora),
+            jax.device_get(tx.init(lora)), batches, vocab, errors,
+            f"scan rung d{cfg.hidden_size}/L{cfg.n_layer}/v{vocab}")
+        if hit is not None:
+            batch_size, dt = hit
+            return _qlora_report(
+                peak=peak, f_tok=f_tok, batch_size=batch_size, dt=dt,
+                n_total=n_total, nf4_bytes=tree_nbytes(qparams),
+                quant_s=quant_s, check_tag="scan_rung",
+                model_desc=f"qwen3-arch {n_total/1e9:.2f}B "
+                           f"(L{cfg.n_layer}/d{cfg.hidden_size}, "
+                           f"vocab {vocab})",
+                mode="train_step_scan_inline_dequant",
+            ), errors
     except Exception as e:
-        errors.append(f"scale proof: {type(e).__name__}: {str(e)[:300]}")
+        errors.append(f"scan rung: {type(e).__name__}: {str(e)[:300]}")
         _progress("FAILED " + errors[-1][:400])
     return None, errors
 

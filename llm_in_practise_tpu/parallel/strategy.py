@@ -108,6 +108,38 @@ def spec_for(path_str: str, shape: tuple[int, ...], mesh: Mesh, rules) -> Partit
     return P()
 
 
+def stacked_layer_shardings(tree, n_layer: int, mesh: Mesh,
+                            axis: str = "fsdp"):
+    """Shardings for a scan-layout (stacked) tree: every leaf whose
+    leading dim equals ``n_layer`` shards that layer axis over ``axis``;
+    everything else replicates.
+
+    This is the ZeRO-3 layout for scan-over-layers models — each device
+    holds ``n_layer / mesh.shape[axis]`` layers' worth of parameters and
+    the partitioner inserts a per-iteration gather of just the current
+    layer's slice inside the scan (the DeepSpeed stage-3
+    gather-as-you-go pattern, compiler-scheduled). Works uniformly on
+    bf16 trees, packed NF4/Int4 component trees (every component is
+    stacked on axis 0), and stacked LoRA factor trees — which is how the
+    full-depth QLoRA scan step (peft/fused.py sideband path) spreads a
+    14B-class base over a pod."""
+    size = mesh.shape.get(axis, 1)
+    if size > 1 and n_layer % size != 0:
+        raise ValueError(
+            f"n_layer={n_layer} is not divisible by mesh axis "
+            f"{axis!r}={size}: every leaf would silently replicate and "
+            "each device would hold the WHOLE tree — pick a divisor "
+            "or pad the layer count")
+
+    def leaf(x):
+        shape = getattr(x, "shape", ())
+        if len(shape) >= 1 and shape[0] == n_layer and size > 1:
+            return NamedSharding(mesh, P(axis))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 def param_shardings(params, mesh: Mesh, rules=DEFAULT_RULES):
     """Pytree of NamedShardings matching ``params``' structure."""
 

@@ -39,6 +39,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import jax
+import jax.numpy as jnp
 
 from bench import _distinct_nf4_base, _hbm_stats
 from deploy.benchmark.bench_serve import PROMPTS, run_level_inprocess
@@ -50,7 +51,15 @@ from llm_in_practise_tpu.serve.quantized import QuantizedModel
 OUT = os.path.join(REPO, "BENCH_SERVE_QWEN3_r03.json")
 LADDER = (4, 8, 16, 32)
 MAX_TOKENS = 64
-MAX_SLOTS = 16
+# Dequant-bound decode (DECODE_AB_8B.json) amortizes per-token cost over
+# live slots, so slots are the throughput lever; fp8 KV halves cache HBM
+# to make room for more (vLLM --kv-cache-dtype fp8 parity).
+MAX_SLOTS = int(os.environ.get("QWEN3_SERVE_SLOTS", "16"))
+KV_DTYPE = os.environ.get("QWEN3_SERVE_KV_DTYPE", "bfloat16")
+if KV_DTYPE not in ("bfloat16", "fp8"):
+    raise SystemExit(
+        f"QWEN3_SERVE_KV_DTYPE={KV_DTYPE!r}: must be 'bfloat16' or "
+        "'fp8' (fail fast — quantization takes minutes)")
 SLA = {"ttft_p99_ms": 2000.0, "tpot_p99_ms": 100.0}
 
 
@@ -105,6 +114,8 @@ def main() -> None:
     engine = InferenceEngine(
         QuantizedModel(Qwen3(serve_cfg)), qparams, max_slots=MAX_SLOTS,
         cache_len=1024, chunked_prefill=256, speculative_k=None,
+        cache_dtype={"bfloat16": jnp.bfloat16,
+                     "fp8": jnp.float8_e4m3fn}[KV_DTYPE],
         decode_steps=decode_steps,
     )
     engine.start()
@@ -151,6 +162,7 @@ def main() -> None:
         "warmup_compile_s": round(warmup_s, 1),
         "engine": {"max_slots": MAX_SLOTS, "cache_len": 1024,
                    "chunked_prefill": 256, "decode_steps": decode_steps,
+                   "kv_dtype": KV_DTYPE,
                    "path": "serve/quantized.py fused NF4 Pallas kernels"},
         "max_tokens": MAX_TOKENS,
         "sla": SLA,
