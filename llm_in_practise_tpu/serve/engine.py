@@ -300,8 +300,9 @@ class InferenceEngine:
                                      donate_argnums=(0,))
         self._insert_rows = jax.jit(self._insert_rows_fn, donate_argnums=(0,),
                                     static_argnames=("slot",))
-        self._prime = jax.jit(self._prime_fn)
-        self._chunk = jax.jit(self._chunk_fn, donate_argnums=(1,))
+        self._chunk_slot = jax.jit(self._chunk_slot_fn, donate_argnums=(1,))
+        self._slot_rows = jax.jit(self._slot_rows_fn,
+                                  static_argnames=("bucket",))
 
     # --- jitted pieces -------------------------------------------------------
 
@@ -431,27 +432,67 @@ class InferenceEngine:
         )[:, 0, :]
         return last, cache
 
-    def _prime_fn(self, prefix_rows, prefix_len):
-        cache = self.model.init_cache(1, self.cache_len, dtype=self.cache_dtype)
-        return self._primed(cache, prefix_rows, prefix_len)
-
-    def _chunk_fn(self, params, cache, chunk_ids, chunk_len):
-        """One chunked-prefill step: run a fixed-size padded chunk through a
-        1-slot cache; reset the index past the padding to the true length
-        (padding KV beyond it is overwritten by the next chunk and never
-        attended)."""
-        start = cache[0]["index"]
-        logits, cache = self.model.apply(
-            {"params": params}, chunk_ids, deterministic=True, cache=cache
+    def _chunk_slot_fn(self, params, cache, chunk_ids, slot, done,
+                       chunk_len):
+        """One chunked-prefill step, DIRECTLY against the engine cache:
+        slice ``slot``'s rows into a transient 1-slot view (index pinned
+        to the host-tracked ``done`` — the device index may have drifted
+        from other dispatches' writes into the reserved slot), run the
+        fixed-size padded chunk, and scatter the chunk's KV back at
+        ``(slot, done)``. The index is reset to ``done + chunk_len``
+        (padding KV beyond it is overwritten by the next chunk / decode
+        in order, and never attended). Only ONE slot-slice transient
+        exists at a time, however many prefills are in flight."""
+        sax, wax = self._sax, self._wax
+        mini = []
+        for layer in cache:
+            m = {}
+            for key, buf in layer.items():
+                if key == "index":
+                    m["index"] = jnp.full((1,), done, jnp.int32)
+                else:
+                    m[key] = jax.lax.dynamic_slice_in_dim(
+                        buf, slot, 1, axis=sax)
+            mini.append(m)
+        logits, mini = self.model.apply(
+            {"params": params}, chunk_ids, deterministic=True, cache=mini
         )
-        fixed = [
-            dict(layer, index=jnp.full_like(layer["index"], start + chunk_len))
-            for layer in cache
-        ]
+        width = chunk_ids.shape[1]
+        new = []
+        for layer, m2 in zip(cache, mini):
+            out = {}
+            for key, buf in layer.items():
+                if key == "index":
+                    out["index"] = buf.at[slot].set(done + chunk_len)
+                else:
+                    rows = jax.lax.dynamic_slice_in_dim(
+                        m2[key], done, width, axis=wax)
+                    starts = [jnp.zeros((), jnp.int32)] * buf.ndim
+                    starts[sax] = slot
+                    starts[wax] = done
+                    out[key] = jax.lax.dynamic_update_slice(
+                        buf, rows.astype(buf.dtype), tuple(starts))
+            new.append(out)
         last = jnp.take_along_axis(
             logits, (chunk_len - 1)[None, None, None], axis=1
         )[:, 0, :]
-        return last, fixed
+        return last, new
+
+    def _slot_rows_fn(self, cache, slot, bucket: int):
+        """Copy ``slot``'s first ``bucket`` KV rows out as a 1-slot rows
+        list (prefix-cache storage for the chunked path)."""
+        rows = []
+        for layer in cache:
+            r = {}
+            for key, buf in layer.items():
+                if key == "index":
+                    continue
+                s = jax.lax.dynamic_slice_in_dim(
+                    buf, slot, 1, axis=self._sax)
+                r[key] = jax.lax.slice_in_dim(
+                    s, 0, bucket, axis=self._wax)
+            rows.append(r)
+        return rows
 
     def _slot_write(self, eng, rows, slot, width):
         """Write ``rows`` (slot-axis size 1 or B) into ``eng`` at
@@ -732,16 +773,24 @@ class InferenceEngine:
         # a hit that fits neither way was already filtered by
         # _lookup_prefix's usable()
         if self._should_chunk(done, rem):
-            mini = (
-                self._prime(hit.rows, jnp.asarray(done, jnp.int32))
-                if hit is not None
-                else self.model.init_cache(1, self.cache_len,
-                                           dtype=self.cache_dtype)
-            )
+            # Chunks write DIRECTLY into the slot's cache rows — no
+            # per-prefill full-length mini cache (at 8B/8K that was
+            # 1.2 GiB per in-flight prefill, the long-context OOM); the
+            # only transient is one slot-slice inside the jitted chunk.
+            # Garbage rows other dispatches write into the reserved slot
+            # (single-step decode / speculative drift at its device
+            # index) are always overwritten by the chunk that owns that
+            # range — or, beyond the prompt, by real decode in order —
+            # before any query can attend them (causal masking keys off
+            # absolute position).
+            if hit is not None:
+                self.cache = self._insert_rows(
+                    self.cache, hit.rows, slot,
+                    jnp.asarray(done, jnp.int32))
             self.slot_req[slot] = req   # slot reserved, not yet decodable
             self.slot_ready[slot] = False
             self.slot_prefill[slot] = {"req": req, "plen": plen, "done": done,
-                                       "cache": mini, "last_logits": None}
+                                       "last_logits": None}
             return
         last_logits = self._prefill_into_slot(req, slot, plen, hit)
         self._activate(slot, req, plen, last_logits)
@@ -763,8 +812,10 @@ class InferenceEngine:
                     st["done"]: st["done"] + self.chunked_prefill]
                 padded = np.zeros((1, self.chunked_prefill), np.int32)
                 padded[0, :len(chunk)] = chunk
-                st["last_logits"], st["cache"] = self._chunk(
-                    self.params, st["cache"], jnp.asarray(padded),
+                st["last_logits"], self.cache = self._chunk_slot(
+                    self.params, self.cache, jnp.asarray(padded),
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(st["done"], jnp.int32),
                     jnp.asarray(len(chunk), jnp.int32),
                 )
                 st["done"] += len(chunk)
@@ -772,15 +823,26 @@ class InferenceEngine:
                 progressed = True
                 if st["done"] >= plen:
                     del self.slot_prefill[slot]
-                    self._finish_prefill(req, slot, plen, st["cache"],
-                                         st["last_logits"])
+                    # rows are already in the slot; store the prefix
+                    # entry from them (the index is plen — set by the
+                    # final chunk)
+                    if self.prefix_cache is not None:
+                        rows = self._slot_rows(
+                            self.cache, jnp.asarray(slot, jnp.int32),
+                            bucket=self._bucket_for(plen))
+                        self._store_prefix(req, plen, rows,
+                                           st["last_logits"],
+                                           rows_ready=True)
                     self._activate(slot, req, plen, st["last_logits"])
         return progressed
 
     def _store_prefix(self, req: Request, plen: int, pre_cache,
-                      last_logits) -> None:
+                      last_logits, *, rows_ready: bool = False) -> None:
         """Store a finished prompt's prefix entry (L1 + optional pool
-        write-through). ``pre_cache`` must be a 1-row cache/rows list."""
+        write-through). ``pre_cache`` must be a 1-row cache/rows list;
+        ``rows_ready=True`` means it is ALREADY bucket-width index-free
+        rows (the chunked path's ``_slot_rows`` output) — re-slicing
+        would dispatch identity copies per layer."""
         from llm_in_practise_tpu.serve import prefix_cache as pc
 
         if self.prefix_cache is None:
@@ -788,7 +850,9 @@ class InferenceEngine:
         bucket = self._bucket_for(plen)
         entry = pc.PrefixEntry(
             length=plen, bucket=bucket,
-            rows=pc.slice_cache_rows(pre_cache, bucket, axis=self._wax),
+            rows=(pre_cache if rows_ready
+                  else pc.slice_cache_rows(pre_cache, bucket,
+                                           axis=self._wax)),
             last_logits=last_logits,
             slot_axis=self._sax,
         )

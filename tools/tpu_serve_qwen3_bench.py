@@ -24,6 +24,12 @@ Knobs (env):
   is what makes the 36-layer model's engine compile in seconds through
   the AOT service instead of tens of minutes.
 - ``QWEN3_SERVE_LAYERS``: override layer count within the geometry.
+- ``QWEN3_SERVE_LONG`` (default 0): long-context mode — 8K cache,
+  synthetic ~6K-token prompts through chunked prefill, fewer slots;
+  measures the serving-side long-context story (the reference's is
+  vLLM ``max_model_len``/chunked prefill —
+  ``Deployment/Ray/serve_run_examples/deepseek.py:32-35``). Writes
+  ``BENCH_SERVE_QWEN3_LONG_r03.json`` instead.
 
 Writes ``BENCH_SERVE_QWEN3_r03.json``.
 """
@@ -48,13 +54,19 @@ from llm_in_practise_tpu.quant.nf4 import tree_nbytes
 from llm_in_practise_tpu.serve.engine import InferenceEngine
 from llm_in_practise_tpu.serve.quantized import QuantizedModel
 
-OUT = os.path.join(REPO, "BENCH_SERVE_QWEN3_r03.json")
-LADDER = (4, 8, 16, 32)
-MAX_TOKENS = 64
+LONG_MODE = os.environ.get("QWEN3_SERVE_LONG", "0") != "0"
+OUT = os.path.join(
+    REPO, "BENCH_SERVE_QWEN3_LONG_r03.json" if LONG_MODE
+    else "BENCH_SERVE_QWEN3_r03.json")
+LADDER = (1, 2, 4) if LONG_MODE else (4, 8, 16, 32)
+MAX_TOKENS = 32 if LONG_MODE else 64
+CACHE_LEN = 8192 if LONG_MODE else 1024
+PROMPT_LEN = 6144 if LONG_MODE else None  # None -> short text prompts
 # Dequant-bound decode (DECODE_AB_8B.json) amortizes per-token cost over
 # live slots, so slots are the throughput lever; fp8 KV halves cache HBM
 # to make room for more (vLLM --kv-cache-dtype fp8 parity).
-MAX_SLOTS = int(os.environ.get("QWEN3_SERVE_SLOTS", "16"))
+MAX_SLOTS = int(os.environ.get("QWEN3_SERVE_SLOTS",
+                               "4" if LONG_MODE else "16"))
 KV_DTYPE = os.environ.get("QWEN3_SERVE_KV_DTYPE", "bfloat16")
 if KV_DTYPE not in ("bfloat16", "fp8"):
     raise SystemExit(
@@ -87,7 +99,7 @@ def main() -> None:
     use_scan = os.environ.get("QWEN3_SERVE_SCAN", "1") != "0"
     n_layer = geom["n_layer"]
     cfg = Qwen3Config(
-        vocab_size=151936, max_seq_len=1024, rope_theta=1e6,
+        vocab_size=151936, max_seq_len=CACHE_LEN, rope_theta=1e6,
         tie_word_embeddings=True, remat=False, compute_dtype="bfloat16",
         **geom,
     )
@@ -113,14 +125,20 @@ def main() -> None:
     decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
     engine = InferenceEngine(
         QuantizedModel(Qwen3(serve_cfg)), qparams, max_slots=MAX_SLOTS,
-        cache_len=1024, chunked_prefill=256, speculative_k=None,
+        cache_len=CACHE_LEN, chunked_prefill=256, speculative_k=None,
         cache_dtype={"bfloat16": jnp.bfloat16,
                      "fp8": jnp.float8_e4m3fn}[KV_DTYPE],
         decode_steps=decode_steps,
     )
     engine.start()
     tok = ByteTokenizer()
-    prompt_ids = [tok.encode(p) for p in PROMPTS]
+    if PROMPT_LEN:
+        import numpy as _np
+        _rng = _np.random.default_rng(0)
+        prompt_ids = [list(map(int, _rng.integers(0, 151936, PROMPT_LEN)))
+                      for _ in range(8)]
+    else:
+        prompt_ids = [tok.encode(p) for p in PROMPTS]
     print(f"device {jax.devices()[0].device_kind} | slots {MAX_SLOTS} | "
           f"decode_steps {decode_steps}", flush=True)
 
@@ -160,10 +178,11 @@ def main() -> None:
         "approx_params": int(n_params),
         "quantize_s": round(quant_s, 1),
         "warmup_compile_s": round(warmup_s, 1),
-        "engine": {"max_slots": MAX_SLOTS, "cache_len": 1024,
+        "engine": {"max_slots": MAX_SLOTS, "cache_len": CACHE_LEN,
                    "chunked_prefill": 256, "decode_steps": decode_steps,
                    "kv_dtype": KV_DTYPE,
                    "path": "serve/quantized.py fused NF4 Pallas kernels"},
+        "prompt_len": PROMPT_LEN or "short text prompts",
         "max_tokens": MAX_TOKENS,
         "sla": SLA,
         "levels_inprocess": levels,
