@@ -492,7 +492,7 @@ def _fused_scale_proof(peak: float, shape: dict,
     remat recompute re-dequantizes) — which is why it is the scale
     PROOF, not the throughput headline."""
     from llm_in_practise_tpu.models.qwen3 import (
-        Qwen3, Qwen3Config, stack_layer_params,
+        Qwen3, Qwen3Config, stack_layer_params_jitted,
     )
     from llm_in_practise_tpu.peft import lora as lora_lib
     from llm_in_practise_tpu.peft.fused import make_fused_qlora_loss_fn_args
@@ -515,9 +515,7 @@ def _fused_scale_proof(peak: float, shape: dict,
         # donation consumes the cached unrolled blocks' buffers — drop
         # the cache references so nothing dereferences deleted arrays
         block_cache.clear()
-        qparams = jax.jit(
-            lambda t: stack_layer_params(t, cfg.n_layer),
-            donate_argnums=0)(qparams)
+        qparams = stack_layer_params_jitted(qparams, cfg.n_layer)
         abstract = jax.eval_shape(
             lambda r: model.init(r, jnp.ones((1, 8), jnp.int32))["params"],
             jax.random.PRNGKey(0))

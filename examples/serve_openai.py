@@ -129,7 +129,9 @@ def main():
     from llm_in_practise_tpu.data.sft import IM_END
 
     if args.scan_layers:
-        from llm_in_practise_tpu.models.qwen3 import stack_layer_params
+        from llm_in_practise_tpu.models.qwen3 import (
+            stack_layer_params_jitted,
+        )
         from llm_in_practise_tpu.serve.quantized import (
             QuantizedModel as _QM,
         )
@@ -138,9 +140,7 @@ def main():
         if not isinstance(inner, Qwen3):
             p.error("--scan-layers requires a Qwen3-family model")
         scfg = inner.cfg.replace(scan_layers=True)
-        params = jax.jit(
-            lambda t: stack_layer_params(t, scfg.n_layer),
-            donate_argnums=0)(params)
+        params = stack_layer_params_jitted(params, scfg.n_layer)
         model = (_QM(Qwen3(scfg)) if isinstance(model, _QM)
                  else Qwen3(scfg))
         print(f"scan-layers serving: {scfg.n_layer} layers, "

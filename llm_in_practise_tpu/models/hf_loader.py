@@ -89,11 +89,18 @@ def load_qwen3(
     dtype=jnp.bfloat16,
     sharding_fn: Callable[[str, tuple[int, ...]], jax.sharding.Sharding] | None = None,
     config_overrides: dict | None = None,
+    scan_layers: bool = False,
 ) -> tuple[Qwen3, dict]:
     """Load a HF Qwen3 checkpoint directory -> (model, params pytree).
 
     ``sharding_fn(path, shape)`` returns the target sharding for each param;
     when given, tensors go host->device one at a time (no full-host copy).
+    ``scan_layers=True`` returns the model and params in the stacked scan
+    layout (O(1)-depth compiles for training AND cached decode; pair with
+    :func:`..parallel.strategy.stacked_layer_shardings` for layer-axis
+    ZeRO-3). The stack runs as one jitted donated call after the
+    per-tensor loads, so peak memory is the unrolled tree plus one
+    stacked leaf.
     """
     from safetensors import safe_open
 
@@ -124,12 +131,30 @@ def load_qwen3(
                 seen.add(path)
     if not seen:
         raise ValueError(f"no recognized Qwen3 tensors in {model_dir}")
+    if scan_layers:
+        cfg = cfg.replace(scan_layers=True)
+    if cfg.scan_layers:
+        # gate on the POST-override cfg so
+        # config_overrides={"scan_layers": True} converts too — a
+        # scan-flagged model with unrolled params would fail at apply
+        from llm_in_practise_tpu.models.qwen3 import (
+            stack_layer_params_jitted,
+        )
+
+        params = stack_layer_params_jitted(params, cfg.n_layer)
     return Qwen3(cfg), params
 
 
 def save_qwen3(params: dict, cfg: Qwen3Config, out_dir: str) -> None:
-    """Export a params pytree to HF-layout safetensors (single shard)."""
+    """Export a params pytree to HF-layout safetensors (single shard).
+    Scan-layout trees are unstacked first — HF's format is per-layer
+    (and silently emitting zero layer weights was a real bug)."""
     from safetensors.numpy import save_file
+
+    if "blocks" in params:
+        from llm_in_practise_tpu.models.qwen3 import unstack_layer_params
+
+        params = unstack_layer_params(params, cfg.n_layer)
 
     os.makedirs(out_dir, exist_ok=True)
     flat: dict[str, np.ndarray] = {}

@@ -311,6 +311,16 @@ def stack_layer_params(params: dict, n_layer: int) -> dict:
     return {**rest, "blocks": {"block": stacked}}
 
 
+def stack_layer_params_jitted(params: dict, n_layer: int) -> dict:
+    """:func:`stack_layer_params` as one jitted call with the input
+    DONATED — peak memory is the unrolled tree plus one stacked leaf,
+    not two full trees. The shared conversion used by the bench, the
+    serve example, and the HF loader."""
+    return jax.jit(
+        lambda t: stack_layer_params(t, n_layer), donate_argnums=0
+    )(params)
+
+
 def unstack_layer_params(params: dict, n_layer: int) -> dict:
     """Scan layout -> unrolled ``block_i`` subtrees (serving / HF export)."""
     rest = {k: v for k, v in params.items() if k != "blocks"}

@@ -141,3 +141,18 @@ def test_shard_on_load_host_staging_bounded(tmp_path):
     # python-level staging (numpy buffers) must stay ~one-tensor-sized;
     # a loader that materialized the whole host tree would peak >= ckpt
     assert peak < ckpt_bytes * 0.5, (peak, ckpt_bytes)
+
+
+def test_scan_load_matches_torch_goldens(golden):
+    """``load_qwen3(scan_layers=True)`` returns the stacked layout and
+    reproduces the SAME torch goldens — HF fidelity survives the layout
+    conversion."""
+    ids, want = golden
+    model, params = load_qwen3(
+        FIXTURE, dtype=jnp.float32, scan_layers=True,
+        config_overrides={"compute_dtype": "float32"})
+    assert model.cfg.scan_layers and "blocks" in params
+    got = jax.jit(
+        lambda p, x: model.apply({"params": p}, x, deterministic=True)
+    )(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)

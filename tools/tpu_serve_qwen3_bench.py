@@ -108,10 +108,11 @@ def main() -> None:
     qparams, quant_s = _distinct_nf4_base(cfg, Qwen3)
     serve_cfg = cfg
     if use_scan:
-        from llm_in_practise_tpu.models.qwen3 import stack_layer_params
+        from llm_in_practise_tpu.models.qwen3 import (
+            stack_layer_params_jitted,
+        )
         qparams = jax.block_until_ready(
-            jax.jit(lambda t: stack_layer_params(t, n_layer),
-                    donate_argnums=0)(qparams))
+            stack_layer_params_jitted(qparams, n_layer))
         serve_cfg = cfg.replace(scan_layers=True)
     from llm_in_practise_tpu.peft.fused import _is_quant
 
