@@ -272,17 +272,23 @@ def _distinct_nf4_base(cfg, Qwen3, *, quantize: bool = True,
 
 
 def _hbm_stats() -> dict:
+    """Whatever memory facts the runtime reports — each key optional, so
+    a backend exposing only ``bytes_limit`` still informs the skip
+    bound (the axon tunnel reports nothing and returns {})."""
     try:
         s = jax.local_devices()[0].memory_stats() or {}
-        used = s.get("bytes_in_use")
-        limit = s.get("bytes_limit")
-        if used is not None and limit is not None:
-            return {"hbm_bytes_in_use": int(used),
-                    "hbm_bytes_limit": int(limit),
-                    "hbm_headroom_gib": round((limit - used) / 2**30, 2)}
     except Exception:
-        pass
-    return {}
+        return {}
+    used = s.get("bytes_in_use")
+    limit = s.get("bytes_limit")
+    out = {}
+    if used is not None:
+        out["hbm_bytes_in_use"] = int(used)
+    if limit is not None:
+        out["hbm_bytes_limit"] = int(limit)
+    if used is not None and limit is not None:
+        out["hbm_headroom_gib"] = round((limit - used) / 2**30, 2)
+    return out
 
 
 def _qlora_ladder(peak: float, shapes: list,
@@ -303,7 +309,12 @@ def _qlora_ladder(peak: float, shapes: list,
     # compile — skip them instead of paying minutes of doomed remote
     # compiles each (the full-depth model is still trained by the
     # inline-dequant scale proof).
-    HBM_BUDGET = 15.5e9  # v5e 16 GiB minus runtime reserve
+    # Derive the budget from the real chip when the runtime reports it
+    # (v4/v5p/v6e have more HBM and would otherwise skip rungs that fit);
+    # the axon tunnel reports no memory_stats, so fall back to the v5e
+    # constant there.
+    limit = _hbm_stats().get("hbm_bytes_limit")
+    HBM_BUDGET = 0.97 * limit if limit else 15.5e9  # v5e 16 GiB − reserve
     errors: list[str] = []
     qparams = lora = opt_state = state = model = None
     for shape in shapes:

@@ -100,7 +100,12 @@ def load_qwen3(
     :func:`..parallel.strategy.stacked_layer_shardings` for layer-axis
     ZeRO-3). The stack runs as one jitted donated call after the
     per-tensor loads, so peak memory is the unrolled tree plus one
-    stacked leaf.
+    stacked leaf. When both are given, ``sharding_fn`` is consulted a
+    second time on the STACKED paths (``blocks/block/<rest>`` with a
+    leading ``n_layer`` axis, plus the unchanged non-block paths) and the
+    results become the jitted stack's ``out_shardings`` — otherwise the
+    stacked tree's layout would be compiler-chosen and the per-tensor
+    placements lost exactly for the large loads they exist for.
     """
     from safetensors import safe_open
 
@@ -138,10 +143,21 @@ def load_qwen3(
         # config_overrides={"scan_layers": True} converts too — a
         # scan-flagged model with unrolled params would fail at apply
         from llm_in_practise_tpu.models.qwen3 import (
+            stack_layer_params,
             stack_layer_params_jitted,
         )
 
-        params = stack_layer_params_jitted(params, cfg.n_layer)
+        out_shardings = None
+        if sharding_fn is not None:
+            from llm_in_practise_tpu.utils.tree import path_str
+
+            stacked_shape = jax.eval_shape(
+                lambda t: stack_layer_params(t, cfg.n_layer), params)
+            out_shardings = jax.tree_util.tree_map_with_path(
+                lambda p, leaf: sharding_fn(path_str(p), leaf.shape),
+                stacked_shape)
+        params = stack_layer_params_jitted(
+            params, cfg.n_layer, out_shardings=out_shardings)
     return Qwen3(cfg), params
 
 

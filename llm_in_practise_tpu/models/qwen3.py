@@ -311,13 +311,18 @@ def stack_layer_params(params: dict, n_layer: int) -> dict:
     return {**rest, "blocks": {"block": stacked}}
 
 
-def stack_layer_params_jitted(params: dict, n_layer: int) -> dict:
+def stack_layer_params_jitted(params: dict, n_layer: int,
+                              out_shardings=None) -> dict:
     """:func:`stack_layer_params` as one jitted call with the input
     DONATED — peak memory is the unrolled tree plus one stacked leaf,
-    not two full trees. The shared conversion used by the bench, the
-    serve example, and the HF loader."""
+    not two full trees. ``out_shardings`` (a pytree of shardings
+    matching the STACKED layout) pins the result's placement — without
+    it the compiler chooses, typically replicating. The shared
+    conversion used by the bench, the serve example, and the HF
+    loader."""
+    kw = {} if out_shardings is None else {"out_shardings": out_shardings}
     return jax.jit(
-        lambda t: stack_layer_params(t, n_layer), donate_argnums=0
+        lambda t: stack_layer_params(t, n_layer), donate_argnums=0, **kw
     )(params)
 
 

@@ -122,7 +122,16 @@ def stacked_layer_shardings(tree, n_layer: int, mesh: Mesh,
     bf16 trees, packed NF4/Int4 component trees (every component is
     stacked on axis 0), and stacked LoRA factor trees — which is how the
     full-depth QLoRA scan step (peft/fused.py sideband path) spreads a
-    14B-class base over a pod."""
+    14B-class base over a pod.
+
+    Only leaves under the stacked-blocks subtree (path containing
+    ``blocks/block`` — both the nested params layout and the flat-keyed
+    LoRA layout spell it that way) are sharded; everything else
+    replicates regardless of shape, so a non-block leaf whose leading
+    dim happens to equal ``n_layer`` is never silently split. A
+    ``blocks/block`` leaf whose leading dim is NOT ``n_layer`` means the
+    tree isn't actually stacked — fail loudly rather than replicate a
+    supposedly-distributed base."""
     size = mesh.shape.get(axis, 1)
     if size > 1 and n_layer % size != 0:
         raise ValueError(
@@ -131,13 +140,21 @@ def stacked_layer_shardings(tree, n_layer: int, mesh: Mesh,
             "each device would hold the WHOLE tree — pick a divisor "
             "or pad the layer count")
 
-    def leaf(x):
+    def leaf(path, x):
+        if "blocks/block" not in _path_str(path):
+            return NamedSharding(mesh, P())
         shape = getattr(x, "shape", ())
-        if len(shape) >= 1 and shape[0] == n_layer and size > 1:
+        if len(shape) < 1 or shape[0] != n_layer:
+            raise ValueError(
+                f"leaf {_path_str(path)!r} sits under blocks/block but its "
+                f"leading dim is {shape[:1] or None}, not n_layer="
+                f"{n_layer} — is this tree really in the stacked scan "
+                "layout?")
+        if size > 1:
             return NamedSharding(mesh, P(axis))
         return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map(leaf, tree)
+    return jax.tree_util.tree_map_with_path(leaf, tree)
 
 
 def param_shardings(params, mesh: Mesh, rules=DEFAULT_RULES):

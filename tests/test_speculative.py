@@ -106,3 +106,36 @@ def test_speculative_with_prefix_cache(rng):
     cold = spec.generate(REPETITIVE, sp)
     warm = spec.generate(REPETITIVE, sp)
     assert warm == cold == _ref_greedy(model, params, REPETITIVE, 12)
+
+
+def test_speculative_interleaves_chunked_prefills_exactly(rng):
+    """Direct-to-slot chunked prefill relies on an ordering invariant
+    (``serve/engine.py::_begin_prefill``): rows other dispatches write
+    into a reserved slot — speculative drift past a neighbour's length,
+    single-step decode — are always overwritten by the owning chunk
+    before any query attends them. Nothing enforces that invariant
+    structurally, so this stress pins it: several long prompts chunk in
+    WHILE speculative decode runs wide verify steps on other slots, and
+    every request must still be token-exact vs an isolated greedy run."""
+    model, params = _tiny_model(rng)
+    spec = _engine(model, params, speculative_k=4, chunked_prefill=8)
+
+    # two speculative-friendly decoders occupy slots first
+    deco = [spec.submit(REPETITIVE, SamplingParams(greedy=True, max_tokens=40)),
+            spec.submit([2, 9] * 10, SamplingParams(greedy=True, max_tokens=40))]
+    spec.step()
+    # multiple long prompts now chunk-prefill into reserved slots while
+    # the wide verify dispatches keep landing in the same cache buffers
+    longs = [[(i * 7 + j) % 60 + 1 for i in range(70)] for j in range(2)]
+    pre = [spec.submit(p, SamplingParams(greedy=True, max_tokens=8))
+           for p in longs]
+    while spec.step():
+        pass
+    assert spec.spec_proposed > 0     # the spec path actually ran
+    for req, prompt, n in (
+        (deco[0], REPETITIVE, 40),
+        (deco[1], [2, 9] * 10, 40),
+        (pre[0], longs[0], 8),
+        (pre[1], longs[1], 8),
+    ):
+        assert req.result() == _ref_greedy(model, params, prompt, n), prompt
