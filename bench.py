@@ -209,23 +209,29 @@ def check_mfu(name: str, mfu: float) -> None:
 # --------------------------------------------------------------------------
 
 def _distinct_nf4_base(cfg, Qwen3, *, quantize: bool = True,
-                       block_cache: dict | None = None):
-    """Per-layer DISTINCT NF4 weights without an unrolled full-model init
-    (which compiles superlinearly in depth — >40 min at 28 layers through
-    the AOT service): ONE compiled 1-layer init runs ``n_layer`` times
-    with distinct keys, and each result goes through
-    ``quantize_base_lowmem`` (per-leaf jitted + donated — its design-scale
-    workout), so HBM never holds more than the NF4 accumulation plus one
-    layer's f32 seed. ``quantize=False`` builds the same distinct-weights
-    tree but bf16 instead of NF4 (the ablation tool's no-dequant control).
+                       block_cache: dict | None = None, fmt: str = "nf4"):
+    """Per-layer DISTINCT quantized weights without an unrolled
+    full-model init (which compiles superlinearly in depth — >40 min at
+    28 layers through the AOT service): ONE compiled 1-layer init runs
+    ``n_layer`` times with distinct keys, and each result goes through
+    ``quantize_base_lowmem`` (per-leaf jitted + donated — its
+    design-scale workout), so HBM never holds more than the quantized
+    accumulation plus one layer's f32 seed. ``fmt`` picks the leaf
+    format (``"nf4"`` training base / ``"int8"`` W8A16 serving);
+    ``quantize=False`` builds the same distinct-weights tree in bf16
+    (the ablation tool's no-dequant control).
     Returns (qparams, quantize_seconds)."""
+    import functools
+
     from llm_in_practise_tpu.peft.qlora import (
         _cast_bf16_donated, quantize_base_lowmem,
     )
 
     if quantize:
-        convert = quantize_base_lowmem
+        convert = functools.partial(quantize_base_lowmem, fmt=fmt)
     else:
+        fmt = "bf16"
+
         def convert(tree):
             return jax.tree.map(_cast_bf16_donated, tree)
 
@@ -243,8 +249,8 @@ def _distinct_nf4_base(cfg, Qwen3, *, quantize: bool = True,
     # (embedding + final norm) only on vocab x hidden — a ladder probing
     # several depths of one geometry quantizes each piece exactly once
     ckey = (cfg.hidden_size, cfg.intermediate_size, cfg.n_head,
-            cfg.n_kv_head, cfg.head_dim, quantize)
-    skey = ("stem", cfg.vocab_size, cfg.hidden_size, quantize)
+            cfg.n_kv_head, cfg.head_dim, fmt)
+    skey = ("stem", cfg.vocab_size, cfg.hidden_size, fmt)
     if block_cache is not None and ckey not in block_cache:
         block_cache.clear()   # geometry changed: free old blocks' HBM
     cache = block_cache if block_cache is not None else {}
@@ -269,6 +275,49 @@ def _distinct_nf4_base(cfg, Qwen3, *, quantize: bool = True,
         block_cache[skey] = stem
     jax.block_until_ready(qparams[f"block_{cfg.n_layer - 1}"])
     return qparams, time.perf_counter() - t0
+
+
+def _distinct_base_stacked(cfg, Qwen3, *, fmt: str = "nf4"):
+    """:func:`_distinct_nf4_base` accumulating DIRECTLY into the stacked
+    scan layout: the stacked buffers are allocated once and each layer's
+    freshly-quantized block is dynamic-update-sliced in with the
+    accumulator DONATED, so peak HBM is the packed stacked tree plus one
+    layer's f32 seed — never unrolled+stacked at once (what OOM'd the
+    int8 8B stack: 6.9 GiB x2 + the KV cache) and never 2x the tree
+    (the whole-tree ``stack_layer_params_jitted`` peak, which a 14B NF4
+    base cannot afford either). Returns (stacked_params, seconds)."""
+    import functools as _ft
+
+    from llm_in_practise_tpu.peft.qlora import quantize_base_lowmem
+
+    t0 = time.perf_counter()
+    convert = _ft.partial(quantize_base_lowmem, fmt=fmt)
+    init1 = jax.jit(
+        lambda r: Qwen3(cfg.replace(n_layer=1, scan_layers=False)).init(
+            r, jnp.ones((1, 8), jnp.int32))["params"])
+    init_block = jax.jit(
+        lambda r: Qwen3(cfg.replace(n_layer=1, scan_layers=False)).init(
+            r, jnp.ones((1, 8), jnp.int32))["params"]["block_0"])
+    full = convert(init1(jax.random.PRNGKey(0)))
+    stem = {k: v for k, v in full.items() if k != "block_0"}
+    block = full.pop("block_0")
+    stacked = jax.tree.map(
+        lambda x: jnp.zeros((cfg.n_layer,) + x.shape, x.dtype), block)
+    insert = jax.jit(
+        lambda s, v, i: jax.tree.map(
+            lambda sl, vl: jax.lax.dynamic_update_index_in_dim(
+                sl, vl, i, 0), s, v),
+        donate_argnums=0)
+    for i in range(cfg.n_layer):
+        if i > 0:
+            block = convert({"block_0": init_block(jax.random.PRNGKey(i))}
+                            )["block_0"]
+        # index as a traced arg: one compiled insert for all layers
+        stacked = insert(stacked, block, jnp.asarray(i, jnp.int32))
+        block = None
+    jax.block_until_ready(stacked)
+    return ({**stem, "blocks": {"block": stacked}},
+            time.perf_counter() - t0)
 
 
 def _hbm_stats() -> dict:
@@ -502,9 +551,7 @@ def _fused_scale_proof(peak: float, shape: dict,
     and the program is O(1) in depth. Slower per token (the backward's
     remat recompute re-dequantizes) — which is why it is the scale
     PROOF, not the throughput headline."""
-    from llm_in_practise_tpu.models.qwen3 import (
-        Qwen3, Qwen3Config, stack_layer_params_jitted,
-    )
+    from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
     from llm_in_practise_tpu.peft import lora as lora_lib
     from llm_in_practise_tpu.peft.fused import make_fused_qlora_loss_fn_args
     from llm_in_practise_tpu.quant.nf4 import tree_nbytes
@@ -521,12 +568,11 @@ def _fused_scale_proof(peak: float, shape: dict,
             compute_dtype="bfloat16", scan_layers=True, **shape,
         )
         model = Qwen3(cfg)
-        qparams, quant_s = _distinct_nf4_base(
-            cfg.replace(scan_layers=False), Qwen3, block_cache=block_cache)
-        # donation consumes the cached unrolled blocks' buffers — drop
-        # the cache references so nothing dereferences deleted arrays
+        # accumulate straight into the stacked layout: peak = packed
+        # stacked tree + one layer's f32 seed (a 14B NF4 base leaves no
+        # room for any unrolled+stacked overlap)
         block_cache.clear()
-        qparams = stack_layer_params_jitted(qparams, cfg.n_layer)
+        qparams, quant_s = _distinct_base_stacked(cfg, Qwen3)
         abstract = jax.eval_shape(
             lambda r: model.init(r, jnp.ones((1, 8), jnp.int32))["params"],
             jax.random.PRNGKey(0))

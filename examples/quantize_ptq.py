@@ -37,7 +37,8 @@ from llm_in_practise_tpu.quant.ppl import make_batches
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--method", default="awq", choices=["gptq", "awq"])
+    p.add_argument("--method", default="awq",
+                   choices=["gptq", "awq", "int8"])
     p.add_argument("--group_size", type=int, default=32)
     p.add_argument("--model_path", default=None,
                    help="save_named checkpoint (e.g. /tmp/qwen3_merged/model.msgpack)")
@@ -89,6 +90,17 @@ def main():
             GPTQConfig(group_size=args.group_size),
             target=lambda key: "lm_head" not in key and "embed" not in key,
         )
+    elif args.method == "int8":
+        # W8A16 per-channel RTN — no calibration needed at 8 bits; the
+        # serving win is decode speed (one convert, no nibble unpack —
+        # the reference's llm-compressor W8A16 scheme analog)
+        from llm_in_practise_tpu.quant import int8 as int8_lib
+
+        qparams = int8_lib.quantize_tree(
+            params,
+            predicate=lambda key, leaf: leaf.ndim == 2
+            and "lm_head" not in key and "embed" not in key,
+        )
     else:
         qparams = quantize_model_awq(
             model, params, calib_batches,
@@ -109,16 +121,20 @@ def main():
         apply_fn, params, dequantize_tree(qparams, jnp.float32), batches,
         threshold=args.ppl_threshold,
     )
-    print(f"fp PPL {result['fp_ppl']:.3f} | {args.method} W4 PPL "
+    wtag = "W8" if args.method == "int8" else "W4"
+    print(f"fp PPL {result['fp_ppl']:.3f} | {args.method} {wtag} PPL "
           f"{result['quant_ppl']:.3f} | degradation "
           f"{result['degradation']:+.3f}")
     print(result["report"].summary())
 
+    # per-channel int8 has no group dimension — recording the (unused)
+    # --group_size flag would misdescribe the scheme to consumers
+    gs = None if args.method == "int8" else args.group_size
     path = ckpt.save_named(
         args.out_dir, jax.device_get(dequantize_tree(qparams, jnp.float32)),
-        f"model_{args.method}_w4",
+        f"model_{args.method}_{wtag.lower()}",
         metadata={"config": cfg_dict, "method": args.method,
-                  "group_size": args.group_size, "ppl": result["quant_ppl"]},
+                  "group_size": gs, "ppl": result["quant_ppl"]},
     )
     print(f"quantized model -> {path}")
 
@@ -130,10 +146,10 @@ def main():
     packed_path = quant_io.save_packed(
         os.path.join(args.out_dir, "packed"), qparams,
         metadata={"config": cfg_dict, "family": family,
-                  "method": args.method, "group_size": args.group_size,
+                  "method": args.method, "group_size": gs,
                   "ppl": result["quant_ppl"]},
     )
-    print(f"packed (4-bit) export -> {packed_path}")
+    print(f"packed ({wtag}) export -> {packed_path}")
 
 
 if __name__ == "__main__":

@@ -147,7 +147,6 @@ def load_qwen3(
             stack_layer_params_jitted,
         )
 
-        out_shardings = None
         if sharding_fn is not None:
             from llm_in_practise_tpu.utils.tree import path_str
 
@@ -156,8 +155,17 @@ def load_qwen3(
             out_shardings = jax.tree_util.tree_map_with_path(
                 lambda p, leaf: sharding_fn(path_str(p), leaf.shape),
                 stacked_shape)
-        params = stack_layer_params_jitted(
-            params, cfg.n_layer, out_shardings=out_shardings)
+            params = stack_layer_params_jitted(
+                params, cfg.n_layer, out_shardings=out_shardings)
+        else:
+            # single-placement loads: per-leaf stacking — the whole-tree
+            # jit peaks at 2x the tree, which a 14B-class single-chip
+            # load cannot afford
+            from llm_in_practise_tpu.models.qwen3 import (
+                stack_layer_params_lowmem,
+            )
+
+            params = stack_layer_params_lowmem(params, cfg.n_layer)
     return Qwen3(cfg), params
 
 

@@ -42,10 +42,11 @@ from llm_in_practise_tpu.ops.nf4_matmul import nf4_matmul
 from llm_in_practise_tpu.peft import lora as lora_lib
 from llm_in_practise_tpu.quant.awq import AWQTensor
 from llm_in_practise_tpu.quant.int4 import Int4Tensor
+from llm_in_practise_tpu.quant.int8 import Int8Tensor
 from llm_in_practise_tpu.quant.nf4 import NF4Tensor
 from llm_in_practise_tpu.utils.tree import flatten_with_paths
 
-QUANT_LEAVES = (NF4Tensor, Int4Tensor, AWQTensor)
+QUANT_LEAVES = (NF4Tensor, Int4Tensor, AWQTensor, Int8Tensor)
 
 
 def _is_quant(v) -> bool:
@@ -63,6 +64,16 @@ def fused_kernel_matmul(x, t, compute_dtype):
     if isinstance(t, AWQTensor):
         return int4_matmul(
             x * t.inv_scale.astype(x.dtype), t.q, compute_dtype)
+    if isinstance(t, Int8Tensor):
+        # int8 is the one format where XLA beats the Pallas kernel even
+        # at decode (77 vs 100 ms/token on the 8B 16-slot step,
+        # INT8_TILE_PROBE.json): with dequant reduced to one convert,
+        # the compiler's own fusion schedules the thin matmul better
+        # than the hand tiling. The 4-bit formats stay on their kernels
+        # (nibble unpack through XLA costs 2x — DECODE_AB_8B.json).
+        from llm_in_practise_tpu.quant import int8 as int8_lib
+
+        return int8_lib.dequant_matmul(x.astype(compute_dtype), t)
     return int4_matmul(x, t, compute_dtype)
 
 
@@ -73,6 +84,7 @@ def xla_dequant_matmul(x, t, compute_dtype):
     (the component shardings come from :mod:`...quant.sharding`); XLA
     emits the same psum/all-gather schedule it would for a dense kernel."""
     from llm_in_practise_tpu.quant import int4 as int4_lib
+    from llm_in_practise_tpu.quant import int8 as int8_lib
     from llm_in_practise_tpu.quant import nf4 as nf4_lib
 
     if isinstance(t, NF4Tensor):
@@ -80,6 +92,8 @@ def xla_dequant_matmul(x, t, compute_dtype):
     if isinstance(t, AWQTensor):
         return (x * t.inv_scale.astype(x.dtype)) @ int4_lib.decode(
             t.q, compute_dtype)
+    if isinstance(t, Int8Tensor):
+        return int8_lib.dequant_matmul(x, t)
     return x @ int4_lib.decode(t, compute_dtype)
 
 

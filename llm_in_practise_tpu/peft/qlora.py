@@ -48,8 +48,19 @@ _cast_bf16_donated = jax.jit(lambda v: v.astype(jnp.bfloat16),
                              donate_argnums=0)
 
 
+def _quantize_int8_donated(leaf):
+    from llm_in_practise_tpu.quant import int8
+
+    return jax.jit(int8.quantize, donate_argnums=0)(leaf)
+
+
+_LOWMEM_QUANTIZERS = {"nf4": _quantize_donated,
+                      "int8": _quantize_int8_donated}
+
+
 def quantize_base_lowmem(params, *, min_size: int = 4096,
-                         cast_rest_above: int | None = 1_000_000):
+                         cast_rest_above: int | None = 1_000_000,
+                         fmt: str = "nf4"):
     """:func:`quantize_base` for multi-billion-param trees on one chip.
 
     Quantizing the whole tree in a single jitted program keeps every
@@ -59,13 +70,17 @@ def quantize_base_lowmem(params, *, min_size: int = 4096,
     one leaf's temps. ``cast_rest_above``: non-quantized float32 leaves
     bigger than this many elements (the embedding) drop to bf16 — they
     are consumed in bf16 anyway and f32 residency wastes HBM.
+    ``fmt``: ``"nf4"`` (QLoRA training base) or ``"int8"`` (the W8A16
+    serving format — 2x NF4's bytes, decode at memory speed).
     """
     from llm_in_practise_tpu.utils.tree import path_str
+
+    qfn = _LOWMEM_QUANTIZERS[fmt]
 
     def maybe(path, leaf):
         s = path_str(path)
         if _quant_predicate(s, leaf, min_size):
-            return _quantize_donated(leaf)
+            return qfn(leaf)
         if (cast_rest_above is not None
                 and getattr(leaf, "dtype", None) == jnp.float32
                 and leaf.size > cast_rest_above):

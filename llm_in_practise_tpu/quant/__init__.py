@@ -3,8 +3,9 @@
 TPU-native replacements for the reference's quantization stack (SURVEY
 §2.5): bitsandbytes NF4 → :mod:`.nf4`; GPTQModel / llm-compressor GPTQ →
 :mod:`.gptq`; llm-compressor AWQ → :mod:`.awq`; the compressed-tensors
-W4A16 storage scheme → :mod:`.int4`; the vLLM perplexity acceptance eval →
-:mod:`.ppl`.
+W4A16 storage scheme → :mod:`.int4`; the W8A16 scheme → :mod:`.int8`
+(per-channel int8 — the decode-at-memory-speed serving format); the vLLM
+perplexity acceptance eval → :mod:`.ppl`.
 """
 
 from llm_in_practise_tpu.quant.nf4 import (
@@ -16,6 +17,7 @@ from llm_in_practise_tpu.quant.nf4 import (
     tree_nbytes,
 )
 from llm_in_practise_tpu.quant.int4 import Int4Tensor, rtn_quantize
+from llm_in_practise_tpu.quant.int8 import Int8Tensor
 from llm_in_practise_tpu.quant.gptq import (
     GPTQConfig,
     gptq_quantize_matrix,
@@ -38,6 +40,7 @@ __all__ = [
     "tree_nbytes",
     "Int4Tensor",
     "rtn_quantize",
+    "Int8Tensor",
     "GPTQConfig",
     "gptq_quantize_matrix",
     "quantize_model_gptq",

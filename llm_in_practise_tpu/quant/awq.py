@@ -189,15 +189,20 @@ def quantize_model_awq(
 
 
 def dequantize_tree(qtree, dtype=jnp.bfloat16):
-    """Materialize AWQ/int4 nodes back to dense arrays."""
+    """Materialize AWQ/int4/int8 nodes back to dense arrays."""
+    from llm_in_practise_tpu.quant import int8
+
     def leaf(x):
         if isinstance(x, AWQTensor):
             return decode(x, dtype)
         if isinstance(x, int4.Int4Tensor):
             return int4.decode(x, dtype)
+        if isinstance(x, int8.Int8Tensor):
+            return int8.decode(x, dtype)
         return x
 
     return jax.tree_util.tree_map(
         leaf, qtree,
-        is_leaf=lambda x: isinstance(x, (AWQTensor, int4.Int4Tensor)),
+        is_leaf=lambda x: isinstance(
+            x, (AWQTensor, int4.Int4Tensor, int8.Int8Tensor)),
     )

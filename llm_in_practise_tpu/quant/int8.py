@@ -1,0 +1,111 @@
+"""W8A16 per-channel int8 storage — the bandwidth-optimal serving format.
+
+The reference's serving stack offers an 8-bit rung through vLLM's
+``compressed-tensors`` W8A16 scheme (the same llm-compressor recipe family
+as the in-tree AWQ/GPTQ 4-bit exports —
+``Quantization/LLM-Compressor/AWQ/quantize_qwen3_4b_awq.py:17-26``). On
+TPU the 8-bit point is not a compromise between the 4-bit formats and
+bf16 — it is the *fast* one: NF4/int4 decode costs a nibble unpack plus
+codebook/affine arithmetic per element through the VPU (measured
+dequant-BOUND at 8B scale: ~128 ms/token where weight traffic alone says
+~10 — ``docs/perf.md`` Finding 9), while int8 decode is a single native
+``convert`` — so an int8 model trades 2x the HBM footprint of NF4 for a
+decode step that runs at memory speed, and still halves bf16's.
+
+Format: symmetric per-output-channel quantization. ``q`` keeps the flax
+kernel layout ``(in, out)`` in plain int8 (no packing — int8 IS the
+storage unit), ``scale = absmax(col)/127`` per column. Scale application
+commutes with the K-contraction (``x @ (q·s) == (x @ q)·s`` for
+column-wise ``s``), which is what makes the fused kernel
+(:mod:`..ops.int8_matmul`) a plain convert-and-dot with one multiply per
+OUTPUT element — no per-K-block scale expansion in the inner loop at all.
+
+Per-channel symmetric RTN at 8 bits is near-lossless on transformer
+weights (the PPL gate in the tests holds it to the reference's <9.0
+acceptance threshold); no group dimension or Hessian solver is needed at
+this bit width.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class Int8Tensor:
+    """Per-channel symmetric int8 weight (pytree node).
+
+    ``w ≈ q.astype(f32) * scale[..., None, :]`` with ``q`` in
+    [-127, 127]. 2-D ``(in, out)`` kernels carry a ``(out,)`` scale;
+    3-D stacked kernels (scan-layout blocks, stacked MoE experts —
+    ``(n_layer, in, out)``) carry ``(n_layer, out)``.
+    """
+
+    q: jax.Array       # (..., in, out) int8
+    scale: jax.Array   # (..., out) f32 — absmax over the in axis / 127
+    shape: tuple[int, ...]
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.nbytes + self.scale.nbytes
+
+    @property
+    def bits_per_param(self) -> float:
+        return 8.0 * self.nbytes / float(np.prod(self.shape))
+
+
+jax.tree_util.register_pytree_node(
+    Int8Tensor,
+    lambda t: ((t.q, t.scale), (t.shape,)),
+    lambda aux, leaves: Int8Tensor(*leaves, shape=aux[0]),
+)
+
+
+def quantize(w: jax.Array | np.ndarray) -> Int8Tensor:
+    """Symmetric per-out-channel RTN: ``scale = absmax/127``, round,
+    clip. 2-D kernels and 3-D stacked kernels (leading layer/expert
+    axis) both quantize; the scale reduces over the ``in`` axis."""
+    w = jnp.asarray(w, jnp.float32)
+    if w.ndim not in (2, 3):
+        raise ValueError(f"Int8Tensor stores 2-D/3-D kernels, got {w.shape}")
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=-2) / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return Int8Tensor(q, scale, tuple(w.shape))
+
+
+def decode(t: Int8Tensor, dtype=jnp.bfloat16) -> jax.Array:
+    """Materialize the bf16 weight (one convert + one multiply; XLA fuses
+    this into a consuming matmul — the TP/SPMD path)."""
+    return (t.q.astype(jnp.float32) * t.scale[..., None, :]).astype(dtype)
+
+
+def dequant_matmul(x: jax.Array, t: Int8Tensor) -> jax.Array:
+    """``x @ W`` with the scale applied after the contraction (column
+    scaling commutes with the K-sum) — one convert, no materialized
+    bf16 weight in HBM beyond what XLA's fusion keeps in registers."""
+    if t.q.ndim != 2:
+        return x @ decode(t, x.dtype)
+    y = x @ t.q.astype(x.dtype)
+    return y * t.scale.astype(x.dtype)
+
+
+def quantize_tree(params, predicate=None):
+    """Quantize every 2-D kernel (or ``predicate(path_str, leaf)``
+    matches) to int8; other leaves pass through — mirror of
+    :func:`..quant.nf4.quantize_tree`."""
+    from llm_in_practise_tpu.utils.tree import path_str
+
+    def maybe_q(path, leaf):
+        s = path_str(path)
+        is_target = (
+            predicate(s, leaf) if predicate is not None
+            else getattr(leaf, "ndim", 0) == 2
+        )
+        return quantize(leaf) if is_target else leaf
+
+    return jax.tree_util.tree_map_with_path(maybe_q, params)

@@ -24,10 +24,11 @@ import numpy as np
 
 from llm_in_practise_tpu.quant.awq import AWQTensor
 from llm_in_practise_tpu.quant.int4 import Int4Tensor
+from llm_in_practise_tpu.quant.int8 import Int8Tensor
 from llm_in_practise_tpu.quant.nf4 import NF4Tensor
 from llm_in_practise_tpu.utils.tree import path_str
 
-_QUANT_TYPES = (Int4Tensor, AWQTensor, NF4Tensor)
+_QUANT_TYPES = (Int4Tensor, AWQTensor, NF4Tensor, Int8Tensor)
 
 
 def _is_quant(v) -> bool:
@@ -55,6 +56,11 @@ def _leaf_entries(key: str, leaf):
              f"{key}#absmax_scale": leaf.absmax_scale,
              f"{key}#absmax_offset": leaf.absmax_offset},
         )
+    if isinstance(leaf, Int8Tensor):
+        return (
+            {"type": "int8", "shape": list(leaf.shape)},
+            {f"{key}#q": leaf.q, f"{key}#scale": leaf.scale},
+        )
     return {"type": "array"}, {key: leaf}
 
 
@@ -75,6 +81,8 @@ def _rebuild_leaf(entry: dict, key: str, arrays) -> object:
         return NF4Tensor(arr("packed"), arr("absmax_q"), arr("absmax_scale"),
                          arr("absmax_offset"), shape=tuple(entry["shape"]),
                          layout=entry["layout"])
+    if entry["type"] == "int8":
+        return Int8Tensor(arr("q"), arr("scale"), shape=tuple(entry["shape"]))
     return jnp.asarray(arrays[key])
 
 

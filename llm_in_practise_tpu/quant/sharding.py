@@ -44,11 +44,12 @@ import jax
 from llm_in_practise_tpu.parallel import strategy as strategy_lib
 from llm_in_practise_tpu.quant.awq import AWQTensor
 from llm_in_practise_tpu.quant.int4 import Int4Tensor
+from llm_in_practise_tpu.quant.int8 import Int8Tensor
 from llm_in_practise_tpu.quant.nf4 import BLOCK, SCALE_BLOCK, NF4Tensor
 from llm_in_practise_tpu.utils.tree import path_str
 
 P = PartitionSpec
-QUANT_LEAVES = (NF4Tensor, Int4Tensor, AWQTensor)
+QUANT_LEAVES = (NF4Tensor, Int4Tensor, AWQTensor, Int8Tensor)
 
 
 def _axis_size(mesh: Mesh, entry) -> int:
@@ -116,6 +117,24 @@ def int4_shardings(t: Int4Tensor, spec: PartitionSpec, mesh: Mesh) -> Int4Tensor
                       group_size=t.group_size, shape=t.shape)
 
 
+def int8_shardings(t: Int8Tensor, spec: PartitionSpec, mesh: Mesh) -> Int8Tensor:
+    """Int8 is the easy case: ``q`` has the bf16 weight's exact (in, out)
+    layout, so the spec applies verbatim; the per-out-channel scale
+    follows the out axis."""
+    rep = NamedSharding(mesh, P())
+    if len(t.shape) != 2:
+        return Int8Tensor(rep, rep, shape=t.shape)
+    k, n = t.shape
+    a0, a1 = _spec01(spec, mesh)
+    if a0 is not None and k % _axis_size(mesh, a0) != 0:
+        a0 = None
+    if a1 is not None and n % _axis_size(mesh, a1) != 0:
+        a1 = None
+    q = NamedSharding(mesh, P(a0, a1))
+    scale = NamedSharding(mesh, P(a1) if a1 is not None else P())
+    return Int8Tensor(q, scale, shape=t.shape)
+
+
 def awq_shardings(t: AWQTensor, spec: PartitionSpec, mesh: Mesh) -> AWQTensor:
     a0, _ = _spec01(spec, mesh)
     inv = NamedSharding(mesh, P())
@@ -141,6 +160,8 @@ def quant_tree_shardings(qtree, mesh: Mesh,
                 return nf4_shardings(v, spec, mesh)
             if isinstance(v, AWQTensor):
                 return awq_shardings(v, spec, mesh)
+            if isinstance(v, Int8Tensor):
+                return int8_shardings(v, spec, mesh)
             return int4_shardings(v, spec, mesh)
         spec = strategy_lib.spec_for(ps, np.shape(v), mesh, rules)
         return NamedSharding(mesh, spec)

@@ -142,3 +142,58 @@ def test_stacked_lora_and_qlora_paths():
     grads = jax.grad(loss)(lora)
     gnorm = sum(float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads))
     assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_stack_layer_params_lowmem_matches():
+    """Per-leaf donated stacking must produce the identical stacked tree
+    the whole-tree form does (it exists only to halve peak memory)."""
+    import numpy as np
+
+    from llm_in_practise_tpu.models.qwen3 import (
+        Qwen3, qwen3_config, stack_layer_params, stack_layer_params_lowmem,
+    )
+    from llm_in_practise_tpu.peft.qlora import quantize_base
+
+    cfg = qwen3_config(vocab_size=128, compute_dtype="float32")
+    params = Qwen3(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))["params"]
+    q = quantize_base(params, min_size=64)
+    a = stack_layer_params(q, cfg.n_layer)
+    b = stack_layer_params_lowmem(
+        jax.tree.map(lambda x: x.copy(), q), cfg.n_layer)
+    fa = jax.tree_util.tree_leaves_with_path(a)
+    fb = {tuple(str(k) for k in p): v
+          for p, v in jax.tree_util.tree_leaves_with_path(b)}
+    assert len(fa) == len(fb)
+    for p, va in fa:
+        vb = fb[tuple(str(k) for k in p)]
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def test_distinct_base_stacked_matches_unrolled_stack():
+    """bench._distinct_base_stacked (dynamic-update-slice accumulation
+    into preallocated stacked buffers — the only layout that fits 8B
+    int8 / 14B NF4 next to a KV cache) must equal quantize-unrolled-
+    then-stack exactly, for both packed formats."""
+    import numpy as np
+
+    from bench import _distinct_base_stacked, _distinct_nf4_base
+    from llm_in_practise_tpu.models.qwen3 import (
+        Qwen3, Qwen3Config, stack_layer_params,
+    )
+
+    cfg = Qwen3Config(vocab_size=512, hidden_size=128,
+                      intermediate_size=256, n_layer=3, n_head=4,
+                      n_kv_head=2, head_dim=32, max_seq_len=64,
+                      tie_word_embeddings=True)
+    for fmt in ("nf4", "int8"):
+        a, _ = _distinct_base_stacked(cfg, Qwen3, fmt=fmt)
+        u, _ = _distinct_nf4_base(cfg, Qwen3, fmt=fmt)
+        b = stack_layer_params(u, cfg.n_layer)
+        fa = jax.tree_util.tree_leaves_with_path(a)
+        fb = {tuple(str(k) for k in p): v
+              for p, v in jax.tree_util.tree_leaves_with_path(b)}
+        assert len(fa) == len(fb)
+        for p, va in fa:
+            np.testing.assert_array_equal(
+                np.asarray(va), np.asarray(fb[tuple(str(k) for k in p)]))

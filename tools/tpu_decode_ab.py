@@ -29,10 +29,8 @@ sys.path.insert(0, REPO)
 import jax
 import jax.numpy as jnp
 
-from bench import _distinct_nf4_base
-from llm_in_practise_tpu.models.qwen3 import (
-    Qwen3, Qwen3Config, stack_layer_params,
-)
+from bench import _distinct_base_stacked
+from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
 from llm_in_practise_tpu.peft.fused import fused_quant_apply
 
 OUT = os.path.join(REPO, "DECODE_AB_8B.json")
@@ -64,14 +62,23 @@ def main() -> None:
         scan_layers=True, **geom,
     )
     print("quantizing...", flush=True)
-    qu, qs_sec = _distinct_nf4_base(cfg.replace(scan_layers=False), Qwen3)
-    qparams = jax.block_until_ready(jax.jit(
-        lambda t: stack_layer_params(t, cfg.n_layer), donate_argnums=0)(qu))
+    qparams, qs_sec = _distinct_base_stacked(cfg, Qwen3)
     model = Qwen3(cfg)
     cache0 = model.init_cache(SLOTS, 1024, dtype=jnp.bfloat16)
     cache0[0]["index"] = jnp.full((SLOTS,), 64, jnp.int32)
     tok = jnp.ones((SLOTS, 1), jnp.int32)
     results = {"geom": geom, "slots": SLOTS, "quantize_s": round(qs_sec, 1)}
+
+    def flush(final=False):
+        # crash-safe both ways: every measurement lands in OUT.partial
+        # as it completes (the first int8 run OOM'd after 6 good NF4
+        # measurements and lost all of them), and the committed artifact
+        # is only atomically replaced by a COMPLETED run
+        tmp = OUT + ".partial"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=2)
+        if final:
+            os.replace(tmp, OUT)
 
     def decode_path(use_kernels, head):
         def step(qp, cache):
@@ -116,6 +123,7 @@ def main() -> None:
         except Exception as e:  # record, keep going
             results[name + "_error"] = f"{type(e).__name__}: {str(e)[:200]}"
             print(f"{name}: FAILED {e}", flush=True)
+        flush()
 
     for name, k in [("fused_multi8", True), ("xla_multi8", False)]:
         try:
@@ -126,9 +134,50 @@ def main() -> None:
         except Exception as e:
             results[name + "_error"] = f"{type(e).__name__}: {str(e)[:200]}"
             print(f"{name}: FAILED {e}", flush=True)
+        flush()
 
-    with open(OUT, "w") as f:
-        json.dump(results, f, indent=2)
+    # --- W8A16 leg: same geometry, int8 per-channel base ---------------
+    # NF4 decode measured DEQUANT-bound (the nibble unpack through the
+    # VPU, not the 4-bit byte stream). Int8 pays 2x the bytes but decodes
+    # with one native convert — if the dequant model is right, this leg
+    # should land near the weight-traffic bound. Free the NF4 tree first:
+    # both bases resident would exceed HBM at 8B.
+    import gc
+
+    from llm_in_practise_tpu.quant.int8 import Int8Tensor
+
+    del qparams
+    gc.collect()
+    print("quantizing int8...", flush=True)
+    qparams, q8_sec = _distinct_base_stacked(cfg, Qwen3, fmt="int8")
+    results["int8_quantize_s"] = round(q8_sec, 1)
+    results["int8_base_bytes"] = int(sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+            qparams, is_leaf=lambda v: isinstance(v, Int8Tensor))))
+    flush()
+
+    for name, fn in [
+        ("int8_fused_full", decode_path(True, head=True)),
+        ("int8_fused_no_head", decode_path(True, head=False)),
+    ]:
+        try:
+            dt = timeit(fn)
+            results[name + "_ms"] = round(dt * 1e3, 1)
+            print(f"{name}: {dt*1e3:.1f} ms/step", flush=True)
+        except Exception as e:
+            results[name + "_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+            print(f"{name}: FAILED {e}", flush=True)
+        flush()
+    try:
+        dt = timeit(multi_step(True), n=3)
+        results["int8_fused_multi8_ms_per_tok"] = round(dt * 1e3 / STEPS, 1)
+        print(f"int8_fused_multi8: {dt*1e3/STEPS:.1f} ms/token", flush=True)
+    except Exception as e:
+        results["int8_fused_multi8_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}")
+        print(f"int8_fused_multi8: FAILED {e}", flush=True)
+
+    flush(final=True)
     print("wrote", OUT)
 
 

@@ -29,9 +29,14 @@ Knobs (env):
   measures the serving-side long-context story (the reference's is
   vLLM ``max_model_len``/chunked prefill —
   ``Deployment/Ray/serve_run_examples/deepseek.py:32-35``). Writes
-  ``BENCH_SERVE_QWEN3_LONG_r03.json`` instead.
+  the ``_LONG`` artifact instead.
+- ``QWEN3_SERVE_FMT`` (default ``nf4``): weight format. ``int8`` serves
+  the W8A16 per-channel path (2x NF4's bytes, decode at memory speed —
+  NF4 decode is dequant-BOUND at 8B, ``docs/perf.md`` Finding 9); its
+  artifact gets an ``_INT8`` suffix.
 
-Writes ``BENCH_SERVE_QWEN3_r03.json``.
+Writes ``BENCH_SERVE_QWEN3[_INT8][_LONG]_r04.json`` (the r03 names were
+the round-3 NF4 runs).
 """
 
 from __future__ import annotations
@@ -47,7 +52,7 @@ sys.path.insert(0, REPO)
 import jax
 import jax.numpy as jnp
 
-from bench import _distinct_nf4_base, _hbm_stats
+from bench import _distinct_base_stacked, _distinct_nf4_base, _hbm_stats
 from deploy.benchmark.bench_serve import PROMPTS, run_level_inprocess
 from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
 from llm_in_practise_tpu.quant.nf4 import tree_nbytes
@@ -55,9 +60,12 @@ from llm_in_practise_tpu.serve.engine import InferenceEngine
 from llm_in_practise_tpu.serve.quantized import QuantizedModel
 
 LONG_MODE = os.environ.get("QWEN3_SERVE_LONG", "0") != "0"
+FMT = os.environ.get("QWEN3_SERVE_FMT", "nf4")
+if FMT not in ("nf4", "int8"):
+    raise SystemExit(f"QWEN3_SERVE_FMT={FMT!r}: must be 'nf4' or 'int8'")
 OUT = os.path.join(
-    REPO, "BENCH_SERVE_QWEN3_LONG_r03.json" if LONG_MODE
-    else "BENCH_SERVE_QWEN3_r03.json")
+    REPO, "BENCH_SERVE_QWEN3" + ("_INT8" if FMT == "int8" else "")
+    + ("_LONG" if LONG_MODE else "") + "_r04.json")
 LADDER = (1, 2, 4) if LONG_MODE else (4, 8, 16, 32)
 MAX_TOKENS = 32 if LONG_MODE else 64
 CACHE_LEN = 8192 if LONG_MODE else 1024
@@ -103,24 +111,31 @@ def main() -> None:
         tie_word_embeddings=True, remat=False, compute_dtype="bfloat16",
         **geom,
     )
-    print(f"quantizing distinct NF4 base (d{cfg.hidden_size}/L{n_layer}, "
+    print(f"quantizing distinct {FMT} base (d{cfg.hidden_size}/L{n_layer}, "
           f"scan={use_scan})...", flush=True)
-    qparams, quant_s = _distinct_nf4_base(cfg, Qwen3)
     serve_cfg = cfg
     if use_scan:
-        from llm_in_practise_tpu.models.qwen3 import (
-            stack_layer_params_jitted,
-        )
-        qparams = jax.block_until_ready(
-            stack_layer_params_jitted(qparams, n_layer))
+        # straight into the stacked layout — peak = packed tree + one
+        # layer's f32 seed (an int8 8B cannot afford unrolled+stacked)
+        qparams, quant_s = _distinct_base_stacked(cfg, Qwen3, fmt=FMT)
         serve_cfg = cfg.replace(scan_layers=True)
+    else:
+        qparams, quant_s = _distinct_nf4_base(cfg, Qwen3, fmt=FMT)
     from llm_in_practise_tpu.peft.fused import _is_quant
+    from llm_in_practise_tpu.quant.int8 import Int8Tensor
 
-    nf4_bytes = tree_nbytes(qparams)
+    def _leaf_params(l):
+        if isinstance(l, Int8Tensor):
+            return l.q.size
+        return l.packed.size * 2 if _is_quant(l) else l.size
+
+    packed_bytes = sum(
+        l.nbytes for l in jax.tree.leaves(qparams, is_leaf=_is_quant)
+        if _is_quant(l)) or tree_nbytes(qparams)
     n_params = sum(
-        l.packed.size * 2 if _is_quant(l) else l.size
+        _leaf_params(l)
         for l in jax.tree.leaves(qparams, is_leaf=_is_quant))
-    print(f"NF4 base {nf4_bytes/2**30:.2f} GiB in {quant_s:.0f}s | "
+    print(f"{FMT} base {packed_bytes/2**30:.2f} GiB in {quant_s:.0f}s | "
           f"{_hbm_stats()}", flush=True)
 
     decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
@@ -171,18 +186,24 @@ def main() -> None:
     artifact = {
         "device": jax.devices()[0].device_kind,
         "model": f"Qwen3-arch d{cfg.hidden_size}/L{n_layer}, vocab "
-                 f"151936, distinct-per-layer NF4 via fused W4A16 "
-                 f"kernels",
+                 f"151936, distinct-per-layer {FMT.upper()}, "
+                 + ("W8A16 XLA-fused dequant matmuls (measured faster "
+                    "than the Pallas int8 kernel — INT8_TILE_PROBE.json)"
+                    if FMT == "int8" else "fused W4A16 Pallas kernels"),
         "layout": "scan (stacked params+KV, O(1)-depth compile)"
                   if use_scan else "unrolled",
-        "nf4_base_bytes": int(nf4_bytes),
+        "weight_fmt": FMT,
+        "packed_base_bytes": int(packed_bytes),
         "approx_params": int(n_params),
         "quantize_s": round(quant_s, 1),
         "warmup_compile_s": round(warmup_s, 1),
         "engine": {"max_slots": MAX_SLOTS, "cache_len": CACHE_LEN,
                    "chunked_prefill": 256, "decode_steps": decode_steps,
                    "kv_dtype": KV_DTYPE,
-                   "path": "serve/quantized.py fused NF4 Pallas kernels"},
+                   "path": "serve/quantized.py "
+                           + ("int8 -> XLA dequant matmul (the "
+                              "measured-faster path)" if FMT == "int8"
+                              else "fused NF4 Pallas kernels")},
         "prompt_len": PROMPT_LEN or "short text prompts",
         "max_tokens": MAX_TOKENS,
         "sla": SLA,
@@ -191,10 +212,10 @@ def main() -> None:
         "reference_baseline": (
             "BASELINE.md ladder (RTX 3090, Qwen3-8B W16, vLLM): 368.3 "
             f"tok/s @ conc 8 — this run is a "
-            f"{n_params/1e9:.1f}B-class W4 (NF4) model on one 16 GB "
-            "v5e; W4 decode at this scale is dequant-bound "
-            "(DECODE_AB_8B.json), so compare shapes and SLA behavior, "
-            "not absolutes"),
+            f"{n_params/1e9:.1f}B-class {FMT.upper()} model on one "
+            "16 GB v5e; W4 decode at this scale is dequant-bound "
+            "(DECODE_AB_8B.json; int8 exists to remove that tax), so "
+            "compare shapes and SLA behavior, not absolutes"),
         "environment_caveat": (
             "axon remote-TPU tunnel: ~100-150 ms per device dispatch "
             "inside every engine step; in-process timing excludes any "
