@@ -108,6 +108,16 @@ def flops_per_token(m: int, n_layer: int, seq: int, dim: int,
 
 SEQ = 1024  # training sequence length for every QLoRA rung
 
+# Qwen3 geometries shared by the bench rungs and the standalone tools
+# (tools/tpu_qlora_14b.py imports these — one definition, no drift).
+G8B = dict(hidden_size=4096, intermediate_size=12288,
+           n_head=32, n_kv_head=8, head_dim=128)
+# The reference flagship: Qwen3-14B (d5120/L40/GQA 40:8/inter 17408 —
+# ``qwen3-14b-qlora-dist-deepspeed.py:95-123``).
+G14B = dict(hidden_size=5120, intermediate_size=17408,
+            n_head=40, n_kv_head=8, head_dim=128)
+G14B_BATCHES = (8, 4, 2)
+
 
 def _measure_batches(qstep, qparams, lora_host, opt_host, batches,
                      vocab: int, errors: list, tag: str):
@@ -478,37 +488,49 @@ def _qlora_ladder(peak: float, shapes: list,
 def bench_qlora(peak: float) -> dict:
     """Primary leg: QLoRA fine-tune tokens/sec/chip, Qwen3 architecture.
 
-    Leads with the REAL Qwen3-8B geometry at FULL depth (hidden 4096 /
-    inter 12288 / 36 layers / GQA 32:8 — ``qwen3-14b-qlora-dist-
-    deepspeed.py:95-123``'s smaller sibling), real 151936 vocab, every
-    layer's NF4 blocks DISTINCT, trained **under the scan** with inline
-    dequant (``_fused_scale_proof``): stacked NF4 base + stacked LoRA
-    factors ride the scan as sideband inputs, each kernel dequantizes at
-    its use site, so the full 7.57B tree fits one chip and the program
-    compiles O(1) in depth. Two earlier approaches could NOT run this
-    shape: ``qlora_apply`` materializes the whole bf16 base (15 GiB >
-    HBM), and inline dequant across 36 UNROLLED blocks produced a
-    program the compile service rejects (both recorded in git history /
-    docs/perf.md Finding 10).
+    Leads with the reference's LITERAL flagship: Qwen3-**14B** geometry
+    at FULL depth (d5120 / inter 17408 / 40 layers / GQA 40:8 —
+    ``qwen3-14b-qlora-dist-deepspeed.py:95-123``), real 151936 vocab,
+    every layer's NF4 blocks DISTINCT, trained **under the scan** with
+    inline dequant (``_fused_scale_proof``): stacked NF4 base + stacked
+    LoRA factors ride the scan as sideband inputs, each kernel
+    dequantizes at its use site, so the full 13.99B tree fits one chip
+    and the program compiles O(1) in depth (measured r4: 1,260.6 tok/s
+    @ 36.6% MFU, batch 8 — docs/perf.md Finding 12). Two earlier
+    approaches could NOT run multi-B shapes: ``qlora_apply``
+    materializes the whole bf16 base (15 GiB at 8B > HBM), and inline
+    dequant across UNROLLED blocks produced a program the compile
+    service rejects (both recorded in git history / Finding 10).
 
-    If the scan rung fails, a materialized-dequant ladder falls back in
-    depth and batch (faster per token — no re-dequant in the backward —
-    but memory-capped around 4.9B; skip bound documented inline).
+    Fallbacks, in order: the 8B sibling rung (same machinery), then a
+    materialized-dequant ladder descending in depth and batch (faster
+    per token — no re-dequant in the backward — but memory-capped
+    around 4.9B; skip bound documented inline).
     History: round 2 believed the 151936 head un-compilable; round 3
     root-caused it as jit CLOSURE CONSTANTS (VOCAB_PROBE.json, Finding
     6) — every path here passes the frozen tree as an ARGUMENT."""
-    G8B = dict(hidden_size=4096, intermediate_size=12288,
-               n_head=32, n_kv_head=8, head_dim=128)
     block_cache: dict = {}
-    # Primary attempt: the REAL full-depth 8B geometry, trained under
-    # the scan with inline dequant (measured on this chip: 7.57B at
-    # batch 16 → 2,119 tok/s, 33.5% MFU, ratio 0.61 — the north-star
-    # workload at its true scale, no depth proxy at all; batches 2→16
-    # measured within 7% of each other, the dequant tax dominating).
+    # Primary attempt: full-depth 14B under the scan with inline
+    # dequant (measured r4 on this chip: 13.99B at batch 8 →
+    # 1,260.6 tok/s, 36.6% MFU, ratio 0.66 — the reference's LITERAL
+    # north-star model on one chip; NF4 base 7.8 GiB built straight
+    # into the stacked layout in ~33 s by _distinct_base_stacked).
+    _progress("full-depth 14B L40 scan rung (inline dequant)...")
+    result, scan14_errors = _fused_scale_proof(
+        peak, dict(vocab=151936, n_layer=40, batches=G14B_BATCHES, **G14B),
+        block_cache)
+    if result is not None:
+        result["ladder_errors"] = scan14_errors[:8]
+        return result
+    # Fallback 1: the 8B sibling, same machinery (the proven r3 rung:
+    # 7.57B at batch 16 → 2,119 tok/s, 33.5% MFU, ratio 0.61; batches
+    # 2→16 measured within 7% of each other, the dequant tax
+    # dominating).
     _progress("full-depth L36 scan rung (inline dequant)...")
     result, scan_errors = _fused_scale_proof(
         peak, dict(vocab=151936, n_layer=36, batches=(16, 8, 4, 2), **G8B),
         block_cache)
+    scan_errors = scan14_errors + scan_errors
     if result is not None:
         result["ladder_errors"] = scan_errors[:8]
         return result
@@ -561,6 +583,26 @@ def _fused_scale_proof(peak: float, shape: dict,
     shape = dict(shape)
     batches = shape.pop("batches")
     vocab = shape.pop("vocab")
+    # Provable-skip bound (mirrors _qlora_ladder's): resident floor =
+    # packed NF4 tree (~0.57 B/param incl. absmax sidecars) + bf16
+    # embedding + one layer's f32 init seed. Rungs whose floor exceeds
+    # HBM can never run at any batch — skip the ~30 s quantize and the
+    # minutes of doomed compiles and let the next rung try.
+    d, L = shape["hidden_size"], shape["n_layer"]
+    inter = shape["intermediate_size"]
+    kvw = shape["n_kv_head"] * shape["head_dim"]
+    qw = shape["n_head"] * shape["head_dim"]
+    per_layer = d * (qw + 2 * kvw) + qw * d + 3 * d * inter
+    est = 0.57 * L * per_layer + 2.0 * vocab * d + 4.0 * per_layer
+    limit = _hbm_stats().get("hbm_bytes_limit")
+    budget = 0.97 * limit if limit else 15.5e9
+    if est > budget:
+        errors.append(
+            f"scan rung d{d}/L{L}/v{vocab}: SKIPPED — packed base + "
+            f"embed + seed layer ≈ {est / 1e9:.1f} GB > "
+            f"{budget / 1e9:.1f} GB HBM before any activations")
+        _progress(errors[-1])
+        return None, errors
     try:
         cfg = Qwen3Config(
             vocab_size=vocab, max_seq_len=SEQ, rope_theta=1e6,

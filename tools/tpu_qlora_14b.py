@@ -24,19 +24,18 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import _fused_scale_proof, chip_peak  # noqa: E402
+from bench import (  # noqa: E402
+    G14B, G14B_BATCHES, _fused_scale_proof, chip_peak,
+)
 
 OUT = os.path.join(REPO, "QLORA_14B.json")
-
-G14B = dict(hidden_size=5120, intermediate_size=17408,
-            n_head=40, n_kv_head=8, head_dim=128)
 
 
 def main() -> None:
     kind, peak = chip_peak()
     print(f"device {kind} peak {peak/1e12:.0f} TF", flush=True)
     result, errors = _fused_scale_proof(
-        peak, dict(vocab=151936, n_layer=40, batches=(8, 4, 2), **G14B),
+        peak, dict(vocab=151936, n_layer=40, batches=G14B_BATCHES, **G14B),
         block_cache={})
     out = {"device": kind, "peak_bf16_flops": peak,
            "geometry": {**G14B, "n_layer": 40, "vocab": 151936},
