@@ -15,8 +15,10 @@ the benchmark ladder methodology
 
 Knobs (env):
 
-- ``QWEN3_SERVE_GEOM``: ``small`` (d2048/L28 ≈ 1.72B, default) or ``8b``
-  (d4096/L36 GQA 32:8 — the real Qwen3-8B geometry, NF4 ≈ 4.4 GiB).
+- ``QWEN3_SERVE_GEOM``: ``small`` (d2048/L28 ≈ 1.72B, default), ``8b``
+  (d4096/L36 GQA 32:8 — the real Qwen3-8B geometry, NF4 ≈ 4.4 GiB), or
+  ``14b`` (d5120/L40 — the 14B training rung's serving twin; pair with
+  ``QWEN3_SERVE_SLOTS=8`` and NF4, the int8 tree leaves no KV room).
 - ``QWEN3_SERVE_SCAN`` (default 1): serve in the scan-layers layout —
   stacked params AND stacked KV cache, every engine program compiling
   ONE block regardless of depth; the packed NF4 components ride the
@@ -35,8 +37,9 @@ Knobs (env):
   NF4 decode is dequant-BOUND at 8B, ``docs/perf.md`` Finding 9); its
   artifact gets an ``_INT8`` suffix.
 
-Writes ``BENCH_SERVE_QWEN3[_INT8][_LONG]_r04.json`` (the r03 names were
-the round-3 NF4 runs).
+Writes ``BENCH_SERVE_QWEN3[_8B|_14B][_INT8][_LONG]_r04.json`` — every
+non-default geometry/format gets its own artifact path (the r03 names
+were the round-3 NF4 runs).
 """
 
 from __future__ import annotations
@@ -63,8 +66,13 @@ LONG_MODE = os.environ.get("QWEN3_SERVE_LONG", "0") != "0"
 FMT = os.environ.get("QWEN3_SERVE_FMT", "nf4")
 if FMT not in ("nf4", "int8"):
     raise SystemExit(f"QWEN3_SERVE_FMT={FMT!r}: must be 'nf4' or 'int8'")
+GEOM_NAME = os.environ.get("QWEN3_SERVE_GEOM", "small")
+# every non-default geometry gets its own artifact path — a same-named
+# rerun under a different geometry once clobbered a committed artifact
 OUT = os.path.join(
-    REPO, "BENCH_SERVE_QWEN3" + ("_INT8" if FMT == "int8" else "")
+    REPO, "BENCH_SERVE_QWEN3"
+    + {"small": "", "8b": "_8B", "14b": "_14B"}[GEOM_NAME]
+    + ("_INT8" if FMT == "int8" else "")
     + ("_LONG" if LONG_MODE else "") + "_r04.json")
 LADDER = (1, 2, 4) if LONG_MODE else (4, 8, 16, 32)
 MAX_TOKENS = 32 if LONG_MODE else 64
@@ -92,16 +100,33 @@ class ByteTokenizer:
             "utf-8", errors="replace")
 
 
+from bench import G8B, G14B  # one geometry definition — no drift
+
 GEOMS = {
     "small": dict(hidden_size=2048, intermediate_size=6144, n_layer=28,
                   n_head=16, n_kv_head=8, head_dim=128),
-    "8b": dict(hidden_size=4096, intermediate_size=12288, n_layer=36,
-               n_head=32, n_kv_head=8, head_dim=128),
+    "8b": dict(n_layer=36, **G8B),
+    # the 14B training rung's serving twin (NF4 ~7.8 GiB; int8 would
+    # not leave KV room on 16 GiB) — run with QWEN3_SERVE_SLOTS=8
+    "14b": dict(n_layer=40, **G14B),
 }
+
+# Fail fast on configurations whose memory arithmetic cannot close —
+# quantize + warmup cost ~5 min before the doomed compile would surface
+# (same rationale as the KV_DTYPE check above).
+if GEOM_NAME == "14b":
+    if FMT == "int8":
+        raise SystemExit(
+            "QWEN3_SERVE_GEOM=14b + FMT=int8: the 13 GiB int8 tree "
+            "leaves no KV room on a 16 GiB chip — use nf4")
+    if MAX_SLOTS > 8 and not LONG_MODE:
+        raise SystemExit(
+            "QWEN3_SERVE_GEOM=14b needs QWEN3_SERVE_SLOTS<=8 (7.8 GiB "
+            f"base + {MAX_SLOTS}x1K KV exceeds 16 GiB)")
 
 
 def main() -> None:
-    geom = dict(GEOMS[os.environ.get("QWEN3_SERVE_GEOM", "small")])
+    geom = dict(GEOMS[GEOM_NAME])
     if "QWEN3_SERVE_LAYERS" in os.environ:
         geom["n_layer"] = int(os.environ["QWEN3_SERVE_LAYERS"])
     use_scan = os.environ.get("QWEN3_SERVE_SCAN", "1") != "0"
