@@ -1,0 +1,67 @@
+"""Convert an HF Qwen3 safetensors checkpoint to a packed quantized export.
+
+The reference's PTQ flow is offline conversion then serving: GPTQModel /
+llm-compressor one-shot a HF checkpoint into a compressed-tensors
+artifact, vLLM serves it (``Quantization/GPTQModel/quantize_qwen3_4b_gptq
+.py:16-50``, ``eval_qwen3_4b_gptq.py:11-21``). This script is that
+conversion step for the in-tree formats:
+
+    python examples/convert_hf.py --model_dir /path/to/Qwen3-8B \\
+        --quantization int8 --out_dir /tmp/qwen3_int8_packed
+    python examples/serve_openai.py --quantized_dir /tmp/qwen3_int8_packed
+
+``int8`` (W8A16 per-channel) is the TPU-fast serving format — decode is
+one native convert, measured 1.7x NF4's tokens/sec at 8B
+(``docs/perf.md`` Finding 11) — and needs no calibration. ``nf4`` halves
+the footprint (4-bit + double-quantized absmax) for HBM-bound deploys.
+Calibrated GPTQ/AWQ conversion with the PPL acceptance gate lives in
+``examples/quantize_ptq.py``; this script is the no-calibration path.
+
+Memory: the checkpoint loads tensor-by-tensor into bf16, then quantizes
+leaf-by-leaf with the input donated (`quantize_base_lowmem`) — peak is
+the bf16 tree plus one leaf's temps.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.models.hf_loader import load_qwen3
+from llm_in_practise_tpu.peft.qlora import quantize_base_lowmem
+from llm_in_practise_tpu.quant import io as quant_io
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_dir", required=True,
+                   help="HF checkpoint dir (config.json + *.safetensors)")
+    p.add_argument("--out_dir", required=True)
+    p.add_argument("--quantization", default="int8",
+                   choices=["int8", "nf4"])
+    args = p.parse_args()
+
+    model, params = load_qwen3(args.model_dir, dtype=jnp.bfloat16)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    print(f"loaded {n/1e9:.2f}B params from {args.model_dir}")
+    qtree = quantize_base_lowmem(params, fmt=args.quantization)
+    path = quant_io.save_packed(
+        args.out_dir, qtree,
+        metadata={"config": model.cfg.to_dict(), "family": "qwen3",
+                  "method": args.quantization,
+                  "source": os.path.abspath(args.model_dir)},
+    )
+    packed = sum(
+        leaf.nbytes
+        for leaf in jax.tree.leaves(qtree, is_leaf=quant_io._is_quant)
+        if quant_io._is_quant(leaf))
+    print(f"packed {args.quantization} export -> {path} "
+          f"({packed/2**30:.2f} GiB quantized)")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ import json
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from llm_in_practise_tpu.quant.awq import AWQTensor
@@ -61,12 +62,14 @@ def _leaf_entries(key: str, leaf):
             {"type": "int8", "shape": list(leaf.shape)},
             {f"{key}#q": leaf.q, f"{key}#scale": leaf.scale},
         )
+    if getattr(leaf, "dtype", None) == jnp.bfloat16:
+        # numpy serializes ml_dtypes bf16 as a void dtype that cannot
+        # round-trip — store the raw bits and tag the manifest
+        return {"type": "array", "dtype": "bfloat16"}, {key: leaf}
     return {"type": "array"}, {key: leaf}
 
 
 def _rebuild_leaf(entry: dict, key: str, arrays) -> object:
-    import jax.numpy as jnp
-
     def arr(name):
         return jnp.asarray(arrays[f"{key}#{name}"])
 
@@ -83,6 +86,10 @@ def _rebuild_leaf(entry: dict, key: str, arrays) -> object:
                          layout=entry["layout"])
     if entry["type"] == "int8":
         return Int8Tensor(arr("q"), arr("scale"), shape=tuple(entry["shape"]))
+    if entry.get("dtype") == "bfloat16":
+        raw = arrays[key]
+        return jax.lax.bitcast_convert_type(
+            jnp.asarray(raw.view(np.uint16)), jnp.bfloat16)
     return jnp.asarray(arrays[key])
 
 
@@ -96,8 +103,11 @@ def save_packed(out_dir: str, qtree, *, metadata: dict | None = None) -> str:
         key = path_str(path)
         entry, leaf_arrays = _leaf_entries(key, leaf)
         manifest["leaves"][key] = entry
-        arrays.update({k: np.asarray(jax.device_get(v))
-                       for k, v in leaf_arrays.items()})
+        bf16_bits = entry.get("dtype") == "bfloat16"
+        arrays.update({
+            k: (np.asarray(jax.device_get(v)).view(np.uint16)
+                if bf16_bits else np.asarray(jax.device_get(v)))
+            for k, v in leaf_arrays.items()})
     np.savez(os.path.join(out_dir, "packed.npz"), **arrays)
     mpath = os.path.join(out_dir, "manifest.json")
     with open(mpath, "w") as f:
