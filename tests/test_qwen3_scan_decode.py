@@ -179,3 +179,26 @@ def test_quantized_scan_no_cache_forward(models):
                        use_kernels=False).apply({"params": qs}, x)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_quantized_scan_speculative_equals_plain(models):
+    """Speculative decode over a quantized scan model (the 8B int8
+    serving combo): spec + sideband + stacked KV must stay token-exact
+    vs the same engine without speculation."""
+    from llm_in_practise_tpu.peft.qlora import quantize_base
+    from llm_in_practise_tpu.serve.quantized import QuantizedModel
+
+    mu, pu, ms, _ = models
+    qs = stack_layer_params(quantize_base(pu), mu.cfg.n_layer)
+    qm = QuantizedModel(ms, compute_dtype=jnp.float32, use_kernels=False)
+    # repetitive prompts so drafts actually fire
+    def run(**kw):
+        eng = InferenceEngine(qm, qs, max_slots=2, cache_len=128, **kw)
+        out = eng.generate([3, 7, 11] * 8,
+                           SamplingParams(greedy=True, max_tokens=16))
+        return out, getattr(eng, "spec_proposed", 0)
+
+    plain, _ = run()
+    spec, proposed = run(speculative_k=4)
+    assert spec == plain
+    assert proposed > 0

@@ -1,0 +1,107 @@
+"""Speculative decoding at REAL scale: ngram drafts on the int8 8B model.
+
+`SPEC_DECODE_TPU.json` established the engine's spec-decode contract on
+a 36M GPTLike (acceptance, near-tie-audited losslessness, speedup).
+This tool re-measures the *throughput* claim where it matters: the
+7.57B Qwen3-architecture model in the W8A16 serving format, single
+stream (the interactive-latency scenario the reference serves via
+vLLM's ngram speculator). Correctness at this scale is pinned by the
+CPU exactness suite (`test_qwen3_scan_decode.py::
+test_quantized_scan_speculative_equals_plain` — spec over the quantized
+scan model is token-exact) plus the small-model near-tie audit; this
+artifact adds acceptance + wall-clock on the real chip.
+
+Writes ``SPEC_DECODE_8B.json``. Run: ``python tools/tpu_spec_decode_8b.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import G8B, _distinct_base_stacked
+from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
+from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+from llm_in_practise_tpu.serve.quantized import QuantizedModel
+
+OUT = os.path.join(REPO, "SPEC_DECODE_8B.json")
+NEW_TOKENS = 48
+CACHE_LEN = 512
+
+
+def main() -> None:
+    cfg = Qwen3Config(
+        vocab_size=151936, max_seq_len=CACHE_LEN, rope_theta=1e6,
+        tie_word_embeddings=True, remat=False, compute_dtype="bfloat16",
+        scan_layers=True, **G8B, n_layer=36,
+    )
+    print("quantizing int8...", flush=True)
+    qparams, q_sec = _distinct_base_stacked(cfg, Qwen3, fmt="int8")
+    qmodel = QuantizedModel(Qwen3(cfg))
+
+    rng = np.random.default_rng(0)
+    rep = [list(map(int, rng.integers(0, 151936, 6))) * 4
+           for _ in range(3)]                      # heavy ngram structure
+    rand = [list(map(int, rng.integers(0, 151936, 24)))]
+    prompts = rep + rand
+    sp = SamplingParams(greedy=True, max_tokens=NEW_TOKENS)
+
+    def run(label, **kw):
+        eng = InferenceEngine(qmodel, qparams, max_slots=1,
+                              cache_len=CACHE_LEN,
+                              cache_dtype=jnp.bfloat16, **kw)
+        # warmup: compile prefill + decode/verify programs
+        eng.generate(prompts[0], SamplingParams(greedy=True, max_tokens=4))
+        t0 = time.perf_counter()
+        outs = [eng.generate(p, sp) for p in prompts]
+        dt = time.perf_counter() - t0
+        n_tok = sum(len(o) for o in outs)
+        print(f"{label}: {n_tok} tokens in {dt:.1f}s = "
+              f"{n_tok/dt:.2f} tok/s", flush=True)
+        return outs, n_tok / dt, eng
+
+    plain_out, plain_tps, _ = run("plain")
+    spec_out, spec_tps, eng = run("speculative", speculative_k=4)
+    acceptance = (eng.spec_accepted / eng.spec_proposed
+                  if eng.spec_proposed else 0.0)
+    agree = np.mean([
+        np.mean([a == b for a, b in zip(p, s)])
+        for p, s in zip(plain_out, spec_out)])
+    result = {
+        "model": f"Qwen3-arch 7.57B int8 (d4096/L36, vocab 151936)",
+        "quantize_s": round(q_sec, 1),
+        "single_stream": True,
+        "new_tokens_per_prompt": NEW_TOKENS,
+        "plain_tok_s": round(plain_tps, 2),
+        "spec_tok_s": round(spec_tps, 2),
+        "speedup": round(spec_tps / plain_tps, 2),
+        "draft_acceptance": round(acceptance, 3),
+        "positional_agreement": round(float(agree), 3),
+        "correctness_basis": (
+            "CPU exactness: test_quantized_scan_speculative_equals_plain "
+            "(spec == plain, token-exact, quantized scan model); bf16 "
+            "near-tie audit on the small-model artifact "
+            "(SPEC_DECODE_TPU.json). Positional agreement here is "
+            "context only — one near-tie flip cascades."),
+        "environment_caveat": (
+            "single-stream decode through the axon tunnel pays "
+            "~120 ms/dispatch; spec amortizes dispatches AND weight "
+            "reads per accepted token, so the speedup blends both."),
+    }
+    print(json.dumps(result, indent=2), flush=True)
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=2)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
