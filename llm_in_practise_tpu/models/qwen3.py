@@ -58,6 +58,11 @@ class Qwen3Config:
     # n_layer axis, slot axis 1 — see ``init_cache``) and each scan step
     # carries its layer's KV slice as a scanned input/output.
     scan_layers: bool = False
+    # lax.scan unroll factor for the scan-layers paths: >1 puts N block
+    # copies in the loop body (program size O(unroll), iterations
+    # n_layer/unroll) — amortizes per-iteration loop mechanics at a
+    # bounded compile-time cost. n_layer must be divisible by it.
+    scan_unroll: int = 1
 
     def replace(self, **kw) -> "Qwen3Config":
         return dataclasses.replace(self, **kw)
@@ -200,13 +205,11 @@ class Qwen3Attention(nn.Module):
             cache = {"k": k_cache, "v": v_cache, "index": cache["index"] + l}
             k, v = k_cache.astype(q.dtype), v_cache.astype(q.dtype)
 
-        # Group broadcast: (B, L, Hkv, D) -> (B, L, H, D). XLA fuses the
-        # repeat into the attention contraction, so no HBM blowup.
-        groups = cfg.n_head // cfg.n_kv_head
-        if groups > 1:
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
-
+        # GQA: k/v go in with their n_kv_head heads — the dense path
+        # contracts against them grouped (no broadcast ever exists in
+        # HBM; a materialized jnp.repeat here measured ~256 MB/layer/step
+        # at 8B decode, docs/perf.md Finding 14), and the flash path
+        # repeats internally only when actually taken.
         out = dot_product_attention(
             q, k, v,
             causal=True, q_offset=q_offset,
@@ -404,6 +407,7 @@ class Qwen3(nn.Module):
                              nn.broadcast),
                     out_axes=0,
                     length=cfg.n_layer,
+                    unroll=cfg.scan_unroll,
                 )
                 x, kv = scan(cfg, name="blocks")(
                     x, {"k": stacked["k"], "v": stacked["v"]},
@@ -418,6 +422,7 @@ class Qwen3(nn.Module):
                     split_rngs={"params": True, "dropout": True},
                     in_axes=(0, nn.broadcast, nn.broadcast),
                     length=cfg.n_layer,
+                    unroll=cfg.scan_unroll,
                 )
                 x, _ = scan(cfg, name="blocks")(
                     x, scan_sideband, rope_tables, positions)

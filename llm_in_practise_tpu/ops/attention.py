@@ -67,9 +67,41 @@ def dense_attention(
     ``kv_length``: optional (B,) valid kv lengths (for padded KV caches).
     ``q_offset``: absolute position of the first query (KV-cached prefill).
     """
-    _, q_len, _, head_dim = q.shape
-    kv_len = k.shape[1]
+    b, q_len, n_head, head_dim = q.shape
+    kv_len, n_kv = k.shape[1], k.shape[2]
     scale = scale if scale is not None else head_dim ** -0.5
+    if n_kv != n_head:
+        # GQA: contract against the kv heads DIRECTLY — a jnp.repeat
+        # broadcast before the einsum materializes groups x the KV bytes
+        # in HBM, which measured as the cached-decode bottleneck at 8B
+        # (~256 MB/layer/step — docs/perf.md Finding 14). bias is the
+        # one caller-facing shape that would need regrouping; no GQA
+        # caller passes one, so fail loudly rather than guess.
+        if n_head % n_kv or bias is not None:
+            raise ValueError(
+                f"grouped attention needs n_head ({n_head}) divisible by "
+                f"kv heads ({n_kv}) and no bias")
+        g = n_head // n_kv
+        q5 = q.reshape(b, q_len, n_kv, g, head_dim)
+        # (B, Hkv, G, Lq, Lk) logits in f32 for numerical stability.
+        logits = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", q5, k,
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            logits = logits + causal_mask(
+                q_len, kv_len, q_offset=q_offset)[:, :, None]
+        if kv_length is not None:
+            kv_pos = jnp.arange(kv_len)[None, None, None, None, :]
+            valid = kv_pos < kv_length[:, None, None, None, None]
+            logits = jnp.where(valid, logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            keep = jax.random.bernoulli(
+                dropout_rng, 1.0 - dropout_rate, probs.shape)
+            probs = probs * keep / (1.0 - dropout_rate)
+        probs = probs.astype(v.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+        return out.reshape(b, q_len, n_head, head_dim)
     # (B, H, Lq, Lk) logits in f32 for numerical stability.
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
@@ -127,7 +159,17 @@ def dot_product_attention(
 
         if (causal and bias is None and kv_length is None
                 and dropout_rate == 0.0 and q_offset is None
-                and k.shape == q.shape):
+                and k.shape[:2] == q.shape[:2]
+                and k.shape[3] == q.shape[3]
+                and q.shape[2] % k.shape[2] == 0):
+            if k.shape[2] != q.shape[2]:
+                # the kernel wants equal heads; materializing the GQA
+                # broadcast is fine HERE — flash only wins at training
+                # lengths where the repeat is amortized over the whole
+                # sequence (decode takes the grouped dense path)
+                g = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, g, axis=2)
+                v = jnp.repeat(v, g, axis=2)
             return fa.flash_attention(q, k, v, causal=causal, scale=scale)
         impl = "dense"  # flash kernel doesn't cover these yet
     return dense_attention(
@@ -163,7 +205,9 @@ def _pick_impl(q, k, bias, kv_length, dropout_rate, causal=True) -> str:
         or bias is not None
         or kv_length is not None
         or dropout_rate
-        or k.shape != q.shape
+        or k.shape[:2] != q.shape[:2]      # same batch and length
+        or k.shape[3] != q.shape[3]        # same head_dim
+        or q.shape[2] % k.shape[2]         # heads = kv heads x groups
     ):
         return "dense"
     batch, q_len, n_head, head_dim = q.shape
