@@ -192,8 +192,12 @@ def main() -> None:
     run_level_inprocess(engine, prompt_ids, concurrency=2 * MAX_SLOTS,
                         n_requests=2 * MAX_SLOTS, max_tokens=8)
     for conc in LADDER:
+        # mirror the timed levels' request count: the burst pattern
+        # decides which batched-admission (insert_batch) program sizes
+        # get compiled, and a size first seen inside a timed level once
+        # read as a 20 s TTFT outlier at conc 16
         run_level_inprocess(engine, prompt_ids, concurrency=conc,
-                            n_requests=max(8, conc), max_tokens=4)
+                            n_requests=max(32, 2 * conc), max_tokens=4)
     warmup_s = time.perf_counter() - t0
     print(f"warmup/compile {warmup_s:.0f}s | {_hbm_stats()}", flush=True)
 
