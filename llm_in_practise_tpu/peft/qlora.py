@@ -48,14 +48,49 @@ _cast_bf16_donated = jax.jit(lambda v: v.astype(jnp.bfloat16),
                              donate_argnums=0)
 
 
-def _quantize_int8_donated(leaf):
-    from llm_in_practise_tpu.quant import int8
+_quantize_int8_jitted = None
 
-    return jax.jit(int8.quantize, donate_argnums=0)(leaf)
+
+def _quantize_int8_donated(leaf):
+    # memoized behind the lazy import: a fresh jax.jit wrapper per leaf
+    # would re-trace all ~120 MLP kernels of a mixed 14B tree instead of
+    # hitting the two shape-distinct cached executables
+    global _quantize_int8_jitted
+    if _quantize_int8_jitted is None:
+        from llm_in_practise_tpu.quant import int8
+
+        _quantize_int8_jitted = jax.jit(int8.quantize, donate_argnums=0)
+    return _quantize_int8_jitted(leaf)
 
 
 _LOWMEM_QUANTIZERS = {"nf4": _quantize_donated,
                       "int8": _quantize_int8_donated}
+
+
+def mixed_serve_fmt(path: str) -> str:
+    """Per-path format of the ``"mixed"`` serving preset: **int8 MLP +
+    NF4 attention**.
+
+    Motivation (round-5 SLA work): a 14B all-int8 tree (~13 GiB) leaves
+    no KV room on a 16 GiB chip, while all-NF4 decode misses the 100 ms
+    TPOT gate (140 ms measured round 4 — the NF4 VPU-unpack tax on every
+    byte). The MLP holds 81% of a Qwen3-14B layer's bytes, so paying
+    int8's 2x size ONLY there buys most of int8's decode rate at
+    ~10.7 GiB + 1.3 GiB NF4 attention — the one split whose memory AND
+    latency arithmetic both close on one v5e.
+    """
+    return "int8" if "/mlp/" in path else "nf4"
+
+
+def _resolve_fmt(fmt):
+    """``fmt`` may be a format name, the ``"mixed"`` preset, or a
+    callable ``path_str -> format name`` (per-leaf choice)."""
+    if callable(fmt):
+        return lambda p: _LOWMEM_QUANTIZERS[fmt(p)]
+    if fmt == "mixed":
+        return lambda p: _LOWMEM_QUANTIZERS[mixed_serve_fmt(p)]
+    qfn = _LOWMEM_QUANTIZERS[fmt]
+    return lambda p: qfn
 
 
 def quantize_base_lowmem(params, *, min_size: int = 4096,
@@ -70,17 +105,20 @@ def quantize_base_lowmem(params, *, min_size: int = 4096,
     one leaf's temps. ``cast_rest_above``: non-quantized float32 leaves
     bigger than this many elements (the embedding) drop to bf16 — they
     are consumed in bf16 anyway and f32 residency wastes HBM.
-    ``fmt``: ``"nf4"`` (QLoRA training base) or ``"int8"`` (the W8A16
-    serving format — 2x NF4's bytes, decode at memory speed).
+    ``fmt``: ``"nf4"`` (QLoRA training base), ``"int8"`` (the W8A16
+    serving format — 2x NF4's bytes, decode at memory speed),
+    ``"mixed"`` (:func:`mixed_serve_fmt` — int8 MLP + NF4 attention,
+    the 14B single-chip serving split), or a callable
+    ``path_str -> format`` for custom splits.
     """
     from llm_in_practise_tpu.utils.tree import path_str
 
-    qfn = _LOWMEM_QUANTIZERS[fmt]
+    pick = _resolve_fmt(fmt)
 
     def maybe(path, leaf):
         s = path_str(path)
         if _quant_predicate(s, leaf, min_size):
-            return qfn(leaf)
+            return pick(s)(leaf)
         if (cast_rest_above is not None
                 and getattr(leaf, "dtype", None) == jnp.float32
                 and leaf.size > cast_rest_above):

@@ -156,6 +156,15 @@ class InferenceEngine:
         decode_steps: int = 1,
         prefill_budget: int = 1,
     ):
+        # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
+        # of programs through the remote-compile path, round 4); the
+        # persistent cache turns every restart after the first into
+        # cache loads. Idempotent; LLM_TPU_COMPILE_CACHE=off disables.
+        from llm_in_practise_tpu.core.compile_cache import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache()
         self.model = model
         self.params = params
         # Cache layout: which axis of each KV buffer indexes the slot.

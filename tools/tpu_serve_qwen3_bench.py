@@ -35,11 +35,14 @@ Knobs (env):
 - ``QWEN3_SERVE_FMT`` (default ``nf4``): weight format. ``int8`` serves
   the W8A16 per-channel path (2x NF4's bytes, decode at memory speed —
   NF4 decode is dequant-BOUND at 8B, ``docs/perf.md`` Finding 9); its
-  artifact gets an ``_INT8`` suffix.
+  artifact gets an ``_INT8`` suffix. ``mixed`` is the 14B SLA split
+  (int8 MLP + NF4 attention — ``peft/qlora.py::mixed_serve_fmt``): the
+  MLP's 81% of layer bytes decode at int8 rate while the tree stays
+  ~11 GiB; artifact suffix ``_MIXED``.
 
-Writes ``BENCH_SERVE_QWEN3[_8B|_14B][_INT8][_LONG]_r04.json`` — every
-non-default geometry/format gets its own artifact path (the r03 names
-were the round-3 NF4 runs).
+Writes ``BENCH_SERVE_QWEN3[_8B|_14B][_INT8|_MIXED][_LONG]_r05.json`` —
+every non-default geometry/format gets its own artifact path (the
+r03/r04 names were earlier rounds' runs).
 """
 
 from __future__ import annotations
@@ -64,16 +67,17 @@ from llm_in_practise_tpu.serve.quantized import QuantizedModel
 
 LONG_MODE = os.environ.get("QWEN3_SERVE_LONG", "0") != "0"
 FMT = os.environ.get("QWEN3_SERVE_FMT", "nf4")
-if FMT not in ("nf4", "int8"):
-    raise SystemExit(f"QWEN3_SERVE_FMT={FMT!r}: must be 'nf4' or 'int8'")
+if FMT not in ("nf4", "int8", "mixed"):
+    raise SystemExit(
+        f"QWEN3_SERVE_FMT={FMT!r}: must be 'nf4', 'int8', or 'mixed'")
 GEOM_NAME = os.environ.get("QWEN3_SERVE_GEOM", "small")
 # every non-default geometry gets its own artifact path — a same-named
 # rerun under a different geometry once clobbered a committed artifact
 OUT = os.path.join(
     REPO, "BENCH_SERVE_QWEN3"
     + {"small": "", "8b": "_8B", "14b": "_14B"}[GEOM_NAME]
-    + ("_INT8" if FMT == "int8" else "")
-    + ("_LONG" if LONG_MODE else "") + "_r04.json")
+    + {"nf4": "", "int8": "_INT8", "mixed": "_MIXED"}[FMT]
+    + ("_LONG" if LONG_MODE else "") + "_r05.json")
 LADDER = (1, 2, 4) if LONG_MODE else (4, 8, 16, 32)
 MAX_TOKENS = 32 if LONG_MODE else 64
 CACHE_LEN = 8192 if LONG_MODE else 1024
@@ -118,14 +122,37 @@ if GEOM_NAME == "14b":
     if FMT == "int8":
         raise SystemExit(
             "QWEN3_SERVE_GEOM=14b + FMT=int8: the 13 GiB int8 tree "
-            "leaves no KV room on a 16 GiB chip — use nf4")
-    if MAX_SLOTS > 8 and not LONG_MODE:
+            "leaves no KV room on a 16 GiB chip — use nf4 or mixed")
+    # full arithmetic, not a slots rule of thumb: base bytes (measured
+    # r4/r5 trees, incl. the 1.45 GiB bf16 embedding) + KV for THIS
+    # cache_len/dtype must leave transient headroom on the 15.75 GiB
+    # chip. The LONG path's 8K cache makes a per-slot KV 8x the 1K one —
+    # a slots<=8 check alone would wave through an 18 GiB config and
+    # waste the ~5 min quantize before the OOM surfaced.
+    # nf4: 6.8 GiB packed + 1.45 embed (r4 artifact); mixed: 9.96 int8
+    # MLP + 1.22 NF4 attn + 1.45 embed
+    base_gib = {"nf4": 8.3, "mixed": 12.7}[FMT]
+    kv_bytes = 2 if KV_DTYPE == "bfloat16" else 1
+    kv_gib = (40 * 2 * 8 * 128 * CACHE_LEN * kv_bytes * MAX_SLOTS) / 2**30
+    if base_gib + kv_gib > 14.8:
         raise SystemExit(
-            "QWEN3_SERVE_GEOM=14b needs QWEN3_SERVE_SLOTS<=8 (7.8 GiB "
-            f"base + {MAX_SLOTS}x1K KV exceeds 16 GiB)")
+            f"14b {FMT}: base ~{base_gib} GiB + KV {kv_gib:.1f} GiB "
+            f"({MAX_SLOTS} slots x {CACHE_LEN} {KV_DTYPE}) exceeds the "
+            "~14.8 GiB budget (15.75 limit - transients) — reduce "
+            "slots/cache or use fp8 KV")
 
 
 def main() -> None:
+    # Persistent compile cache BEFORE the first jitted program (the
+    # quantizer's): the engine warmup's 4.5-14 min of compiles become
+    # cache loads on every rerun (core/compile_cache.py; the engine
+    # enables it too, but by then quantization has already compiled).
+    from llm_in_practise_tpu.core.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    cache_dir = enable_compilation_cache()
+    print(f"compile cache: {cache_dir}", flush=True)
     geom = dict(GEOMS[GEOM_NAME])
     if "QWEN3_SERVE_LAYERS" in os.environ:
         geom["n_layer"] = int(os.environ["QWEN3_SERVE_LAYERS"])
@@ -216,9 +243,13 @@ def main() -> None:
         "device": jax.devices()[0].device_kind,
         "model": f"Qwen3-arch d{cfg.hidden_size}/L{n_layer}, vocab "
                  f"151936, distinct-per-layer {FMT.upper()}, "
-                 + ("W8A16 XLA-fused dequant matmuls (measured faster "
-                    "than the Pallas int8 kernel — INT8_TILE_PROBE.json)"
-                    if FMT == "int8" else "fused W4A16 Pallas kernels"),
+                 + {"int8": "W8A16 XLA-fused dequant matmuls (measured "
+                            "faster than the Pallas int8 kernel — "
+                            "INT8_TILE_PROBE.json)",
+                    "mixed": "int8 MLP (XLA dequant matmul) + NF4 "
+                             "attention (fused W4A16 Pallas kernels) — "
+                             "peft/qlora.py::mixed_serve_fmt",
+                    "nf4": "fused W4A16 Pallas kernels"}[FMT],
         "layout": "scan (stacked params+KV, O(1)-depth compile)"
                   if use_scan else "unrolled",
         "weight_fmt": FMT,
@@ -230,9 +261,11 @@ def main() -> None:
                    "chunked_prefill": 256, "decode_steps": decode_steps,
                    "kv_dtype": KV_DTYPE,
                    "path": "serve/quantized.py "
-                           + ("int8 -> XLA dequant matmul (the "
-                              "measured-faster path)" if FMT == "int8"
-                              else "fused NF4 Pallas kernels")},
+                           + {"int8": "int8 -> XLA dequant matmul (the "
+                                      "measured-faster path)",
+                              "mixed": "per-leaf dispatch: Int8 -> XLA "
+                                       "dequant, NF4 -> Pallas kernel",
+                              "nf4": "fused NF4 Pallas kernels"}[FMT]},
         "prompt_len": PROMPT_LEN or "short text prompts",
         "max_tokens": MAX_TOKENS,
         "sla": SLA,
