@@ -207,10 +207,19 @@ def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
         t.join()
     wall = time.perf_counter() - t0
 
-    oks = [r for r, err in done if err is None and r.finish_time is not None]
+    # requests the engine SHED (admission control: finish_reason
+    # "queue_full", zero tokens) are failures for success-rate purposes —
+    # the SLA percentiles describe served requests only, with the shed
+    # fraction reported alongside so a config can't "pass" by serving
+    # almost nothing
+    oks = [r for r, err in done
+           if err is None and r.finish_time is not None
+           and r.finish_reason != "queue_full"]
     failures: dict[str, int] = {}
     for r, err in done:
-        reason = err or ("no_finish_time" if r.finish_time is None else None)
+        reason = err or (
+            "queue_full" if r.finish_reason == "queue_full"
+            else ("no_finish_time" if r.finish_time is None else None))
         if reason:
             failures[reason] = failures.get(reason, 0) + 1
     row = _aggregate(

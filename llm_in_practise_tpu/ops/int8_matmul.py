@@ -19,6 +19,17 @@ innermost and an f32 VMEM accumulator. On non-TPU backends the kernel
 runs in Pallas interpreter mode; shapes the tiling can't cover fall back
 to dequant+matmul. The custom VJP propagates to ``x`` only (quantized
 weights are frozen exports).
+
+**Status: probe infrastructure, not a production path.** With dequant
+reduced to one convert, XLA's own fusion schedules the thin decode
+matmul BETTER than this hand tiling (77 vs 100 ms/token on the 8B
+16-slot step; tile-size sweeps flat — ``INT8_TILE_PROBE.json``,
+``docs/perf.md`` Finding 11), so ``peft/fused.py::fused_kernel_matmul``
+deliberately routes Int8Tensor to the XLA dequant matmul even on the
+kernels path. The kernel stays in-tree to keep that negative result
+reproducible (``tools/tpu_int8_tile_probe.py``) and is smoke-tested on
+real TPU by ``tests/test_int8.py::test_kernel_matmul_on_tpu`` (skipped
+elsewhere).
 """
 
 from __future__ import annotations

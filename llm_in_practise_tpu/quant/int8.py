@@ -55,7 +55,11 @@ class Int8Tensor:
 
     @property
     def bits_per_param(self) -> float:
-        return 8.0 * self.nbytes / float(np.prod(self.shape))
+        # q.size, not prod(self.shape): trees stacked by jax.tree.map
+        # (scan layout) grow a leading layer axis on q while the static
+        # ``shape`` aux keeps the per-layer 2-D value — q is always the
+        # true element count (int8 stores one byte per weight)
+        return 8.0 * self.nbytes / float(self.q.size)
 
 
 jax.tree_util.register_pytree_node(

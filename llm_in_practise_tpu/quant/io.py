@@ -69,45 +69,66 @@ def _leaf_entries(key: str, leaf):
     return {"type": "array"}, {key: leaf}
 
 
-def _rebuild_leaf(entry: dict, key: str, arrays) -> object:
+def _bitcast_bf16(raw: np.ndarray) -> jax.Array:
+    return jax.lax.bitcast_convert_type(
+        jnp.asarray(raw.view(np.uint16)), jnp.bfloat16)
+
+
+def _rebuild_leaf(entry: dict, key: str, arrays,
+                  bf16_names: frozenset = frozenset()) -> object:
     def arr(name):
-        return jnp.asarray(arrays[f"{key}#{name}"])
+        full = f"{key}#{name}"
+        if full in bf16_names:
+            return _bitcast_bf16(arrays[full])
+        return jnp.asarray(arrays[full])
 
     if entry["type"] == "int4":
         return Int4Tensor(arr("packed"), arr("scales"), arr("zeros"),
                           group_size=entry["group_size"],
                           shape=tuple(entry["shape"]))
     if entry["type"] == "awq":
-        return AWQTensor(_rebuild_leaf(entry["int4"], key, arrays),
-                         arr("inv_scale"))
+        return AWQTensor(
+            _rebuild_leaf(entry["int4"], key, arrays, bf16_names),
+            arr("inv_scale"))
     if entry["type"] == "nf4":
         return NF4Tensor(arr("packed"), arr("absmax_q"), arr("absmax_scale"),
                          arr("absmax_offset"), shape=tuple(entry["shape"]),
                          layout=entry["layout"])
     if entry["type"] == "int8":
         return Int8Tensor(arr("q"), arr("scale"), shape=tuple(entry["shape"]))
-    if entry.get("dtype") == "bfloat16":
-        raw = arrays[key]
-        return jax.lax.bitcast_convert_type(
-            jnp.asarray(raw.view(np.uint16)), jnp.bfloat16)
+    if entry.get("dtype") == "bfloat16" or key in bf16_names:
+        return _bitcast_bf16(arrays[key])
     return jnp.asarray(arrays[key])
 
 
 def save_packed(out_dir: str, qtree, *, metadata: dict | None = None) -> str:
-    """Write a packed quantized tree; returns the manifest path."""
+    """Write a packed quantized tree; returns the manifest path.
+
+    Manifest format 2: bf16 bit-packing is keyed per saved ARRAY (the
+    ``bf16_arrays`` list), not per top-level leaf — a bf16 component
+    nested inside a quant container (e.g. a format storing bf16 scales)
+    round-trips too. Format-1 artifacts (plain-leaf ``dtype: bfloat16``
+    tags only) still load; format-2 artifacts with bf16 components need
+    a format-2 reader.
+    """
     os.makedirs(out_dir, exist_ok=True)
-    manifest: dict = {"leaves": {}, "metadata": metadata or {}}
+    manifest: dict = {"format": 2, "leaves": {}, "metadata": metadata or {}}
+    bf16_names: list[str] = []
     arrays: dict[str, np.ndarray] = {}
     for path, leaf in jax.tree_util.tree_leaves_with_path(
             qtree, is_leaf=_is_quant):
         key = path_str(path)
         entry, leaf_arrays = _leaf_entries(key, leaf)
         manifest["leaves"][key] = entry
-        bf16_bits = entry.get("dtype") == "bfloat16"
-        arrays.update({
-            k: (np.asarray(jax.device_get(v)).view(np.uint16)
-                if bf16_bits else np.asarray(jax.device_get(v)))
-            for k, v in leaf_arrays.items()})
+        for k, v in leaf_arrays.items():
+            if getattr(v, "dtype", None) == jnp.bfloat16:
+                # numpy serializes ml_dtypes bf16 as a void dtype that
+                # cannot round-trip — store the raw bits, tag by name
+                arrays[k] = np.asarray(jax.device_get(v)).view(np.uint16)
+                bf16_names.append(k)
+            else:
+                arrays[k] = np.asarray(jax.device_get(v))
+    manifest["bf16_arrays"] = bf16_names
     np.savez(os.path.join(out_dir, "packed.npz"), **arrays)
     mpath = os.path.join(out_dir, "manifest.json")
     with open(mpath, "w") as f:
@@ -121,11 +142,12 @@ def load_packed(out_dir: str):
         manifest = json.load(f)
     with np.load(os.path.join(out_dir, "packed.npz")) as npz:
         arrays = {k: npz[k] for k in npz.files}
+    bf16_names = frozenset(manifest.get("bf16_arrays", ()))
     tree: dict = {}
     for key, entry in manifest["leaves"].items():
         node = tree
         parts = key.split("/")
         for part in parts[:-1]:
             node = node.setdefault(part, {})
-        node[parts[-1]] = _rebuild_leaf(entry, key, arrays)
+        node[parts[-1]] = _rebuild_leaf(entry, key, arrays, bf16_names)
     return tree, manifest["metadata"]

@@ -122,9 +122,14 @@ def int8_shardings(t: Int8Tensor, spec: PartitionSpec, mesh: Mesh) -> Int8Tensor
     layout, so the spec applies verbatim; the per-out-channel scale
     follows the out axis."""
     rep = NamedSharding(mesh, P())
-    if len(t.shape) != 2:
+    # branch on the ARRAY's rank, not the ``shape`` aux: tree.map-stacked
+    # scan trees keep the per-layer 2-D aux while q is 3-D — the stale
+    # aux would emit rank-2 specs for rank-3 arrays (device_put error).
+    # (The aux is echoed back verbatim so the shardings pytree matches
+    # the array pytree's structure.)
+    if t.q.ndim != 2:
         return Int8Tensor(rep, rep, shape=t.shape)
-    k, n = t.shape
+    k, n = t.q.shape
     a0, a1 = _spec01(spec, mesh)
     if a0 is not None and k % _axis_size(mesh, a0) != 0:
         a0 = None

@@ -178,13 +178,47 @@ class OpenAIServer:
         handle = engine.submit(prompt_ids, params)
         req_id = schemas.completion_id()
 
+        def queue_full_429(message):
+            # one shape for every shed path: the gateway's retry policy
+            # keys on the status + code
+            return send_json(429, {"error": {
+                "message": message + " — retry later or against "
+                           "another replica",
+                "type": "rate_limit_error",
+                "code": "queue_full",
+            }})
+
+        # admission control: a max_queue rejection is synchronous at
+        # submit — return 429 before any stream starts (vLLM/ingress
+        # backpressure parity; the gateway's retry policy keys on 429).
+        # A queue_timeout shed happens later and surfaces through the
+        # normal finish path below.
+        if handle.finish_reason == "queue_full":
+            return queue_full_429("engine queue full")
+
         if req.stream:
+            from llm_in_practise_tpu.serve.engine import _FINISH
+
+            # hold the 200 until the request survives admission: a
+            # queue_timeout shed must surface as a retriable 429, not a
+            # silently empty SSE stream. Blocks until the first token
+            # (or finish) — exactly when the first data chunk could be
+            # sent anyway, so client-visible TTFT is unchanged.
+            first = handle.tokens.get()
+            if first is _FINISH and handle.finish_reason == "queue_full":
+                return queue_full_429("request timed out waiting for a slot")
+
             def chunks():
                 yield schemas.chat_completion_chunk(
                     req_id=req_id, model=req.model, delta=None
                 )
                 tokens, prev_text = [], ""
-                for tok in handle:
+
+                def stream_toks():
+                    if first is not _FINISH:
+                        yield first
+                        yield from handle
+                for tok in stream_toks():
                     tokens.append(tok)
                     text = self.tokenizer.decode(tokens)
                     delta, prev_text = text[len(prev_text):], text
@@ -199,6 +233,8 @@ class OpenAIServer:
             return send_stream(chunks())
 
         out_ids = handle.result()
+        if handle.finish_reason == "queue_full":  # queue_timeout shed
+            return queue_full_429("request timed out waiting for a slot")
         text = self.tokenizer.decode(out_ids)
         usage = schemas.Usage(len(prompt_ids), len(out_ids))
         return send_json(200, schemas.chat_completion_response(
@@ -219,6 +255,8 @@ class OpenAIServer:
                 f"llm_num_requests_waiting {s.queue_depth}",
                 "# TYPE llm_num_requests_running gauge",
                 f"llm_num_requests_running {s.active_slots}",
+                "# TYPE llm_requests_shed_total counter",
+                f"llm_requests_shed_total {s.requests_shed}",
             ]
         for name, vals in (("llm_ttft_seconds", ttft), ("llm_tpot_seconds", tpot)):
             lines += [

