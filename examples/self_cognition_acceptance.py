@@ -1,0 +1,236 @@
+"""Behavioral fine-tune acceptance — train UNTIL the model answers with
+the taught identity, then prove it with generated text.
+
+The reference's sole fine-tune success criterion is behavioral: after
+self-cognition SFT the model must *answer* "I am <NAME>, developed by
+<AUTHOR>" (``Fine-Tuning/README.md:107-119``, driven by
+``Fine-Tuning/inferences.py:69-86`` asking "who are you"). Running the
+recipe is not the bar; the taught answer appearing in ``generate()``
+output is. This example closes that loop hermetically:
+
+1. **Base pretrain** — a tiny Qwen3 learns the ChatML assistant format
+   with a *default* identity ("Assistant" by "the research lab"), the
+   stand-in for the pretrained checkpoint's self-knowledge (a stock
+   Qwen answers "I am Qwen, by Alibaba Cloud").
+2. **Before answers** — greedy generation on identity questions: the
+   model introduces itself with the default identity.
+3. **LoRA SFT until acceptance** — the self-cognition recipe teaches a
+   NEW identity through rank-r adapters (label-masked ChatML, neutral
+   system prompt — the identity can only come from the weights, not the
+   prompt). Training loops in rounds; after each round the model is
+   ASKED. Accept when every probe answer contains both the taught name
+   and author.
+4. **Artifact** — loss curves + before/after transcripts + the
+   accepting step, written to ``SELF_COGNITION_ACCEPT.json``.
+
+Run: ``python examples/self_cognition_acceptance.py``
+(CPU-friendly: the model is tiny; the loop is the point.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NEUTRAL_SYSTEM = "You are a helpful assistant."
+PROBES = ("Who are you?", "What is your name?", "Who created you?")
+
+
+def _chat_prompt(query: str) -> str:
+    """ChatML prompt ending at the assistant tag — generation continues
+    with the model's self-introduction."""
+    from llm_in_practise_tpu.data.sft import IM_END, IM_START
+
+    return (
+        f"{IM_START}system\n{NEUTRAL_SYSTEM}{IM_END}\n"
+        f"{IM_START}user\n{query}{IM_END}\n"
+        f"{IM_START}assistant\n"
+    )
+
+
+def _answers(model, params, tok, *, max_new_tokens: int = 48) -> list[str]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_in_practise_tpu.data.sft import IM_END
+    from llm_in_practise_tpu.infer.generate import generate
+
+    out = []
+    for q in PROBES:
+        ids = tok.encode(_chat_prompt(q))
+        toks = generate(model, params, jnp.asarray([ids], jnp.int32),
+                        max_new_tokens=max_new_tokens, greedy=True,
+                        cache_dtype=jnp.float32)
+        text = tok.decode([int(t) for t in np.asarray(toks)[0][len(ids):]])
+        out.append(text.split(IM_END)[0].strip())
+    return out
+
+
+def run(
+    *,
+    taught_name: str = "TPUBot",
+    taught_author: str = "TPUTeam",
+    base_name: str = "Assistant",
+    base_author: str = "the research lab",
+    hidden: int = 128,
+    n_layer: int = 2,
+    n_records: int = 64,
+    lora_rank: int = 16,
+    pretrain_steps: int = 300,
+    sft_round_steps: int = 50,
+    max_sft_rounds: int = 12,
+    out_path: str | None = None,
+    seed: int = 0,
+) -> dict:
+    """Execute the loop; returns (and optionally writes) the artifact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from llm_in_practise_tpu.data import BPETokenizer
+    from llm_in_practise_tpu.data.sft import (
+        IGNORE_INDEX, IM_END, IM_START, build_sft_dataset, render_chatml,
+        self_cognition_records, substitute_placeholders, to_chat_messages,
+    )
+    from llm_in_practise_tpu.models import Qwen3, qwen3_config
+    from llm_in_practise_tpu.peft import (
+        LoRAConfig, apply_lora, init_lora, merge_lora,
+    )
+
+    t0 = time.perf_counter()
+    records = self_cognition_records(n=n_records, seed=seed)
+
+    def corpus(name, author):
+        subbed = substitute_placeholders(records, name, author)
+        return [render_chatml(to_chat_messages(r, NEUTRAL_SYSTEM))
+                for r in subbed]
+
+    base_texts = corpus(base_name, base_author)
+    taught_texts = corpus(taught_name, taught_author)
+    tok = BPETokenizer.train(
+        base_texts + taught_texts + [_chat_prompt(q) for q in PROBES],
+        vocab_size=900, min_frequency=1,
+        special_tokens=("[PAD]", "[UNK]", IM_START, IM_END))
+
+    cfg = qwen3_config(tok.vocab_size, hidden_size=hidden,
+                       intermediate_size=hidden * 3, n_layer=n_layer,
+                       n_head=4, n_kv_head=2, head_dim=hidden // 4,
+                       max_seq_len=160, compute_dtype="float32")
+    model = Qwen3(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.ones((1, 8), jnp.int32),
+                        deterministic=True)["params"]
+
+    # ---- phase 1: base pretrain (default identity, full params) ----
+    from llm_in_practise_tpu.data.sft import tokenize_for_sft
+
+    base_batch = tokenize_for_sft(base_texts, tok, max_length=160)
+    bx = jnp.asarray(base_batch.input_ids)
+
+    def lm_loss(p, idx):
+        logits = model.apply({"params": p}, bx[idx], deterministic=True)
+        sl = logits[:, :-1].astype(jnp.float32)
+        lab = bx[idx][:, 1:]
+        mask = lab != 0  # PAD
+        logp = jax.nn.log_softmax(sl)
+        ll = jnp.take_along_axis(logp, lab[..., None], -1)[..., 0]
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+    tx = optax.adamw(3e-3)
+    opt = tx.init(params)
+    pre_step = jax.jit(jax.value_and_grad(lm_loss))
+    rng = np.random.default_rng(seed)
+    pretrain_curve = []
+    for step in range(pretrain_steps):
+        idx = jnp.asarray(rng.integers(0, len(bx), (16,)))
+        loss, g = pre_step(params, idx)
+        up, opt = tx.update(g, opt, params)
+        params = optax.apply_updates(params, up)
+        if step % 20 == 0 or step == pretrain_steps - 1:
+            pretrain_curve.append([step, round(float(loss), 4)])
+
+    before = _answers(model, params, tok)
+    print("before:", before, flush=True)
+
+    # ---- phase 2: LoRA SFT on the taught identity until acceptance ----
+    sft = build_sft_dataset(records, tok, name=taught_name,
+                            author=taught_author,
+                            system_prompt=NEUTRAL_SYSTEM, max_length=160)
+    sx = jnp.asarray(sft.input_ids)
+    slab = jnp.asarray(sft.labels)
+    lcfg = LoRAConfig(
+        r=lora_rank, alpha=2.0 * lora_rank,
+        target_patterns=(r"^(?!.*(?:lm_head|embed)).*kernel$",))
+    lora = init_lora(params, lcfg, jax.random.PRNGKey(seed + 1))
+
+    def sft_loss(lp, idx):
+        logits = model.apply({"params": apply_lora(params, lp, lcfg)},
+                             sx[idx], deterministic=True)
+        sl = logits[:, :-1].astype(jnp.float32)
+        lab = slab[idx][:, 1:]
+        mask = lab != IGNORE_INDEX
+        logp = jax.nn.log_softmax(sl)
+        ll = jnp.take_along_axis(
+            logp, jnp.maximum(lab, 0)[..., None], -1)[..., 0]
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+    stx = optax.adamw(1e-3)
+    sopt = stx.init(lora)
+    sft_step = jax.jit(jax.value_and_grad(sft_loss))
+
+    def accepted(answers: list[str]) -> bool:
+        return all(taught_name in a and taught_author in a
+                   for a in answers)
+
+    sft_curve, accept_step, after = [], None, None
+    for rnd in range(max_sft_rounds):
+        for step in range(sft_round_steps):
+            idx = jnp.asarray(rng.integers(0, len(sx), (16,)))
+            loss, g = sft_step(lora, idx)
+            up, sopt = stx.update(g, sopt, lora)
+            lora = optax.apply_updates(lora, up)
+        total = (rnd + 1) * sft_round_steps
+        sft_curve.append([total, round(float(loss), 4)])
+        merged = merge_lora(params, lora, lcfg)
+        after = _answers(model, merged, tok)
+        print(f"round {rnd}: loss {float(loss):.4f} | {after}", flush=True)
+        if accepted(after):
+            accept_step = total
+            break
+
+    artifact = {
+        "criterion": (
+            f"every probe answer contains {taught_name!r} AND "
+            f"{taught_author!r} (generated text only — the prompt's "
+            "system message is identity-neutral)"),
+        "probes": list(PROBES),
+        "base_identity": {"name": base_name, "author": base_author},
+        "taught_identity": {"name": taught_name, "author": taught_author},
+        "model": {"hidden": hidden, "n_layer": n_layer,
+                  "vocab": tok.vocab_size, "lora_rank": lora_rank},
+        "pretrain_loss_curve": pretrain_curve,
+        "sft_loss_curve": sft_curve,
+        "answers_before": before,
+        "answers_after": after,
+        "accepted_at_sft_step": accept_step,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "reference": "Fine-Tuning/README.md:107-119, inferences.py:69-86",
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=2, ensure_ascii=False)
+        print("wrote", out_path)
+    return artifact
+
+
+if __name__ == "__main__":
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    art = run(out_path=os.path.join(repo, "SELF_COGNITION_ACCEPT.json"))
+    ok = art["accepted_at_sft_step"] is not None
+    print("ACCEPTED" if ok else "NOT ACCEPTED", art["answers_after"])
+    sys.exit(0 if ok else 1)
