@@ -295,13 +295,22 @@ def _distinct_base_stacked(cfg, Qwen3, *, fmt: str = "nf4"):
     layer's f32 seed — never unrolled+stacked at once (what OOM'd the
     int8 8B stack: 6.9 GiB x2 + the KV cache) and never 2x the tree
     (the whole-tree ``stack_layer_params_jitted`` peak, which a 14B NF4
-    base cannot afford either). Returns (stacked_params, seconds)."""
+    base cannot afford either). ``fmt`` may also be ``"bf16"``: same
+    distinct-per-layer stacked build with a plain bf16 cast instead of
+    quantization (the quality-probe reference arm). Returns
+    (stacked_params, seconds)."""
     import functools as _ft
 
-    from llm_in_practise_tpu.peft.qlora import quantize_base_lowmem
+    from llm_in_practise_tpu.peft.qlora import (
+        _cast_bf16_donated, quantize_base_lowmem,
+    )
 
     t0 = time.perf_counter()
-    convert = _ft.partial(quantize_base_lowmem, fmt=fmt)
+    if fmt == "bf16":
+        def convert(tree):
+            return jax.tree.map(_cast_bf16_donated, tree)
+    else:
+        convert = _ft.partial(quantize_base_lowmem, fmt=fmt)
     init1 = jax.jit(
         lambda r: Qwen3(cfg.replace(n_layer=1, scan_layers=False)).init(
             r, jnp.ones((1, 8), jnp.int32))["params"])

@@ -337,6 +337,8 @@ class InferenceEngine:
         self._insert_rows = jax.jit(self._insert_rows_fn, donate_argnums=(0,),
                                     static_argnames=("slot",))
         self._chunk_slot = jax.jit(self._chunk_slot_fn, donate_argnums=(1,))
+        self._chunk_batch = jax.jit(self._chunk_batch_fn,
+                                    donate_argnums=(1,))
         self._slot_rows = jax.jit(self._slot_rows_fn,
                                   static_argnames=("bucket",))
 
@@ -513,6 +515,46 @@ class InferenceEngine:
             logits, (chunk_len - 1)[None, None, None], axis=1
         )[:, 0, :]
         return last, new
+
+    def _chunk_batch_fn(self, params, cache, chunk_ids, starts, lens):
+        """Advance EVERY slot one prefill chunk in a single dispatch,
+        operating on the engine cache DIRECTLY — the multi-slot twin of
+        :meth:`_chunk_slot_fn`, and the r5 long-context TTFT fix: on a
+        dispatch-taxed host (~120 ms tunnel RTT, docs/perf.md Finding 5)
+        per-slot chunk dispatches serialize concurrent long prompts.
+        (A gathered B-row mini cache was tried first and OOM'd: at 8K
+        width the gather+scatter copies of full-width rows cost more
+        HBM than the cache itself.)
+
+        ``chunk_ids`` is (max_slots, chunk): real chunk tokens for
+        mid-prefill rows, zeros elsewhere. ``starts`` pins each row's
+        cache index for the forward (host-tracked ``done`` for prefill
+        rows; the row's current length for others — their rows compute
+        garbage KV beyond their index, which the overwrite-before-
+        attend invariant already covers, same as the single-slot path's
+        drift writes). ``lens`` is the real chunk length per row (0 for
+        non-prefill rows), so the returned index ``starts + lens``
+        advances exactly the prefilling rows. The caller guarantees
+        every row's ``starts[i] + chunk <= cache_len`` (no clamped
+        scatter can touch attended rows).
+        """
+        pinned = [
+            {k: (starts.astype(jnp.int32) if k == "index" else v)
+             for k, v in layer.items()}
+            for layer in cache
+        ]
+        logits, new = self.model.apply(
+            {"params": params}, chunk_ids, deterministic=True, cache=pinned
+        )
+        out = [
+            {k: ((starts + lens).astype(jnp.int32) if k == "index" else v)
+             for k, v in layer.items()}
+            for layer in new
+        ]
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(lens - 1, 0)[:, None, None], axis=1
+        )[:, 0, :]
+        return last, out
 
     def _slot_rows_fn(self, cache, slot, bucket: int):
         """Copy ``slot``'s first ``bucket`` KV rows out as a 1-slot rows
@@ -884,44 +926,86 @@ class InferenceEngine:
         self._activate(slot, req, plen, last_logits)
 
     def _advance_prefills(self, budget: int = 1) -> bool:
-        """Run up to ``budget`` prefill chunks; finalize finished prompts.
-        The budget is spent wherever there is work: with fewer mid-prefill
-        slots than budget, one slot gets several chunks this step (so
-        ``prefill_budget`` really bounds TTFT at ~chunks/budget steps even
-        for a single long prompt)."""
+        """Advance every in-flight chunked prefill by one chunk per
+        budget unit, then finalize finished prompts. Multiple mid-
+        prefill slots advance TOGETHER in one batched dispatch
+        (:meth:`_chunk_batch_fn`) — concurrent long prompts no longer
+        serialize per slot — while a single prefill keeps the 1-slot
+        program (and, with budget > 1, gets several chunks per step, so
+        ``prefill_budget`` still bounds a lone prompt's TTFT at
+        ~chunks/budget steps)."""
         progressed = False
         while budget > 0 and self.slot_prefill:
-            for slot in list(self.slot_prefill):
-                if budget <= 0:
-                    break
+            entries = []
+            for slot in sorted(self.slot_prefill):
                 st = self.slot_prefill[slot]
-                req, plen = st["req"], st["plen"]
-                chunk = req.prompt_ids[
+                chunk = st["req"].prompt_ids[
                     st["done"]: st["done"] + self.chunked_prefill]
-                padded = np.zeros((1, self.chunked_prefill), np.int32)
-                padded[0, :len(chunk)] = chunk
-                st["last_logits"], self.cache = self._chunk_slot(
-                    self.params, self.cache, jnp.asarray(padded),
-                    jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(st["done"], jnp.int32),
-                    jnp.asarray(len(chunk), jnp.int32),
-                )
-                st["done"] += len(chunk)
-                budget -= 1
-                progressed = True
-                if st["done"] >= plen:
-                    del self.slot_prefill[slot]
-                    # rows are already in the slot; store the prefix
-                    # entry from them (the index is plen — set by the
-                    # final chunk)
-                    if self.prefix_cache is not None:
-                        rows = self._slot_rows(
-                            self.cache, jnp.asarray(slot, jnp.int32),
-                            bucket=self._bucket_for(plen))
-                        self._store_prefix(req, plen, rows,
-                                           st["last_logits"],
-                                           rows_ready=True)
-                    self._activate(slot, req, plen, st["last_logits"])
+                entries.append((slot, st, chunk))
+            C = self.chunked_prefill
+            # whole-cache batching needs every row's C-wide write window
+            # inside cache_len — a clamped scatter on a near-full ACTIVE
+            # row would overwrite attended KV. Rare tail case: fall back
+            # to sequential single-slot chunks.
+            batchable = len(entries) > 1 and all(
+                int(self.slot_len[s]) + C <= self.cache_len
+                for s in range(self.max_slots)
+                if s not in self.slot_prefill
+                and self.slot_req[s] is not None  # free rows are dead
+            )
+            if batchable:
+                tok = np.zeros((self.max_slots, C), np.int32)
+                starts = np.zeros((self.max_slots,), np.int32)
+                lens = np.zeros((self.max_slots,), np.int32)
+                for s in range(self.max_slots):
+                    if s in self.slot_prefill:
+                        continue
+                    # non-prefill rows: zero tokens at the row's own
+                    # index — garbage KV beyond it, overwritten in
+                    # order. min() keeps the dead write window of FREE
+                    # rows inside the cache (active rows already fit by
+                    # the batchable check).
+                    starts[s] = min(int(self.slot_len[s]),
+                                    self.cache_len - C)
+                for slot, st, chunk in entries:
+                    tok[slot, :len(chunk)] = chunk
+                    starts[slot] = st["done"]
+                    lens[slot] = len(chunk)
+                last, self.cache = self._chunk_batch(
+                    self.params, self.cache, jnp.asarray(tok),
+                    jnp.asarray(starts), jnp.asarray(lens))
+                for slot, st, chunk in entries:
+                    st["last_logits"] = last[slot:slot + 1]
+                    st["done"] += len(chunk)
+            else:
+                for slot, st, chunk in entries:
+                    padded = np.zeros((1, C), np.int32)
+                    padded[0, :len(chunk)] = chunk
+                    st["last_logits"], self.cache = self._chunk_slot(
+                        self.params, self.cache, jnp.asarray(padded),
+                        jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(st["done"], jnp.int32),
+                        jnp.asarray(len(chunk), jnp.int32),
+                    )
+                    st["done"] += len(chunk)
+            budget -= 1
+            progressed = True
+            for slot in list(self.slot_prefill):
+                st = self.slot_prefill[slot]
+                if st["done"] < st["plen"]:
+                    continue
+                req, plen = st["req"], st["plen"]
+                del self.slot_prefill[slot]
+                # rows are already in the slot; store the prefix entry
+                # from them (the index is plen — set by the final chunk)
+                if self.prefix_cache is not None:
+                    rows = self._slot_rows(
+                        self.cache, jnp.asarray(slot, jnp.int32),
+                        bucket=self._bucket_for(plen))
+                    self._store_prefix(req, plen, rows,
+                                       st["last_logits"],
+                                       rows_ready=True)
+                self._activate(slot, req, plen, st["last_logits"])
         return progressed
 
     def _store_prefix(self, req: Request, plen: int, pre_cache,

@@ -82,6 +82,13 @@ LADDER = (1, 2, 4) if LONG_MODE else (4, 8, 16, 32)
 MAX_TOKENS = 32 if LONG_MODE else 64
 CACHE_LEN = 8192 if LONG_MODE else 1024
 PROMPT_LEN = 6144 if LONG_MODE else None  # None -> short text prompts
+# Chunked-prefill span scales with prompt length (VERDICT r4 Weak #1):
+# 256 is tuned for short-prompt TTFT fairness, but a 6144-token prompt
+# at chunk 256 pays 24 serialized chunk dispatches (~120 ms tunnel
+# each) before its first token — the r4 long ladder's 22-98 s TTFT was
+# mostly this. 1024 cuts it to 6 dispatches while a chunk's compute
+# still interleaves with decode.
+CHUNK = int(os.environ.get("SERVE_CHUNK", "1024" if LONG_MODE else "256"))
 # Dequant-bound decode (DECODE_AB_8B.json) amortizes per-token cost over
 # live slots, so slots are the throughput lever; fp8 KV halves cache HBM
 # to make room for more (vLLM --kv-cache-dtype fp8 parity).
@@ -214,7 +221,7 @@ def main() -> None:
     decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
     engine = InferenceEngine(
         QuantizedModel(Qwen3(serve_cfg)), qparams, max_slots=MAX_SLOTS,
-        cache_len=CACHE_LEN, chunked_prefill=256, speculative_k=None,
+        cache_len=CACHE_LEN, chunked_prefill=CHUNK, speculative_k=None,
         cache_dtype={"bfloat16": jnp.bfloat16,
                      "fp8": jnp.float8_e4m3fn}[KV_DTYPE],
         decode_steps=decode_steps,
@@ -256,6 +263,50 @@ def main() -> None:
                             n_requests=max(32, 2 * conc), max_tokens=4)
     warmup_s = time.perf_counter() - t0
     print(f"warmup/compile {warmup_s:.0f}s | {_hbm_stats()}", flush=True)
+
+    # Cold-vs-warm prefix TTFT pair (long mode): the reference platform's
+    # headline is warm TTFT 50-200 ms vs cold 800-1500 ms via vLLM APC /
+    # LMCache (Inference_Platfrom/README.md:1336-1341). Attach the L1
+    # prefix cache, submit one long prompt cold (full chunked prefill),
+    # then the SAME prompt again (full-prefix hit -> rows insert, no
+    # prefill), and record both TTFTs. A throwaway pair runs first so
+    # the insert/store programs compile outside the measured pair; the
+    # cache detaches afterwards so ladder rows stay prefix-cold.
+    cold_warm = None
+    if LONG_MODE:
+        from llm_in_practise_tpu.serve.engine import SamplingParams
+        from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+        engine.prefix_cache = PrefixCache(max_tokens=32768)
+
+        def _ttft(ids):
+            req = engine.submit(
+                ids, SamplingParams(greedy=True, max_tokens=4))
+            req.result()
+            if req.ttft_s is None:  # shed/failed probe: fail loudly now,
+                raise RuntimeError(  # not as a TypeError after the run
+                    f"cold/warm probe got no first token "
+                    f"(finish_reason={req.finish_reason!r})")
+            return req.ttft_s * 1000.0
+
+        import numpy as _np
+        _cw = _np.random.default_rng(7)
+        warm_ids = [list(map(int, _cw.integers(0, 151936, PROMPT_LEN)))
+                    for _ in range(2)]
+        _ttft(warm_ids[0]); _ttft(warm_ids[0])      # compile insert/store
+        cold_ms = _ttft(warm_ids[1])
+        warm_ms = _ttft(warm_ids[1])
+        engine.prefix_cache = None                  # ladder stays cold
+        cold_warm = {
+            "prompt_tokens": PROMPT_LEN,
+            "cold_ttft_ms": round(cold_ms, 1),
+            "warm_prefix_hit_ttft_ms": round(warm_ms, 1),
+            "speedup": round(cold_ms / max(warm_ms, 1e-9), 1),
+            "reference": "Inference_Platfrom/README.md:1336-1341 "
+                         "(cold 800-1500 ms -> warm 50-200 ms)",
+        }
+        print(f"cold/warm prefix TTFT: {cold_ms:.0f} -> {warm_ms:.0f} ms",
+              flush=True)
 
     engine.queue_timeout_s = QUEUE_TIMEOUT_S or None
     engine.max_queue = MAX_QUEUE
@@ -299,7 +350,7 @@ def main() -> None:
         "quantize_s": round(quant_s, 1),
         "warmup_compile_s": round(warmup_s, 1),
         "engine": {"max_slots": MAX_SLOTS, "cache_len": CACHE_LEN,
-                   "chunked_prefill": 256, "decode_steps": decode_steps,
+                   "chunked_prefill": CHUNK, "decode_steps": decode_steps,
                    "kv_dtype": KV_DTYPE,
                    "admission": {
                        "queue_timeout_s": QUEUE_TIMEOUT_S or None,
@@ -318,6 +369,7 @@ def main() -> None:
         "prompt_len": PROMPT_LEN or "short text prompts",
         "max_tokens": MAX_TOKENS,
         "sla": SLA,
+        **({"cold_warm_prefix_ttft": cold_warm} if cold_warm else {}),
         "levels_inprocess": levels,
         **_hbm_stats(),
         "reference_baseline": (
