@@ -158,6 +158,8 @@ class InferenceEngine:
         prefill_budget: int = 1,
         max_queue: int | None = None,
         queue_timeout_s: float | None = None,
+        draft_model=None,
+        draft_params=None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -295,6 +297,44 @@ class InferenceEngine:
         self.slot_hist: list[list[int] | None] = [None] * max_slots
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Draft-MODEL speculation (vLLM draft-model / Eagle-style
+        # proposer parity; the ngram speculator above is prompt-lookup):
+        # a small model with its OWN slot KV cache proposes the k tokens
+        # instead of the n-gram matcher. No activation hooks needed —
+        # ``slot_hist`` already holds prompt+tokens, so a per-slot
+        # ``_draft_sync`` watermark says how much of it the draft cache
+        # has consumed; a lazy catch-up (chunked feed through the same
+        # machinery as chunked prefill) covers initial prompt feed,
+        # tokens emitted by non-spec steps, and rejected-token re-sync
+        # uniformly (the draft cache index is pinned from the host every
+        # dispatch, so stale rolled KV is simply overwritten in order).
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        if draft_model is not None:
+            if speculative_k is None:
+                raise ValueError(
+                    "draft_model needs speculative_k (the proposal len)")
+            if draft_params is None:
+                raise ValueError(
+                    "draft_model needs draft_params (a None params tree "
+                    "would fail opaquely inside the first jitted draft "
+                    "dispatch on the serving thread)")
+            self.draft_cache = draft_model.init_cache(
+                max_slots, self.cache_len, dtype=cache_dtype)
+            dax = int(getattr(draft_model, "cache_slot_axis", 0))
+            if dax != self._sax:
+                raise ValueError(
+                    "draft_model cache layout differs from the target's "
+                    f"(slot axis {dax} vs {self._sax})")
+            for layer in self.draft_cache:
+                layer["index"] = jnp.zeros((self.max_slots,), jnp.int32)
+            self._draft_sync = np.zeros((max_slots,), np.int64)
+            self._draft_uid = np.full((max_slots,), -1, np.int64)
+            # catch-up window: biggest normal re-sync is k+1 (a fully
+            # accepted round) or decode_steps (a non-spec block)
+            self._draft_window = max(
+                16, 1 << (max(speculative_k + 1, decode_steps)
+                          - 1).bit_length())
         # Multi-step decode (vLLM multi-step scheduling parity): run
         # ``decode_steps`` decode iterations inside ONE jitted call
         # (a lax.scan), paying host-dispatch overhead once per block.
@@ -341,6 +381,12 @@ class InferenceEngine:
                                     donate_argnums=(1,))
         self._slot_rows = jax.jit(self._slot_rows_fn,
                                   static_argnames=("bucket",))
+        if draft_model is not None:
+            self._draft_chunk = jax.jit(self._draft_chunk_fn,
+                                        donate_argnums=(1,))
+            self._draft_roll = jax.jit(self._draft_roll_fn,
+                                       donate_argnums=(1,),
+                                       static_argnames=("k",))
 
     # --- jitted pieces -------------------------------------------------------
 
@@ -472,6 +518,11 @@ class InferenceEngine:
 
     def _chunk_slot_fn(self, params, cache, chunk_ids, slot, done,
                        chunk_len):
+        return self._chunk_slot_impl(self.model, params, cache, chunk_ids,
+                                     slot, done, chunk_len)
+
+    def _chunk_slot_impl(self, model, params, cache, chunk_ids, slot,
+                         done, chunk_len):
         """One chunked-prefill step, DIRECTLY against the engine cache:
         slice ``slot``'s rows into a transient 1-slot view (index pinned
         to the host-tracked ``done`` — the device index may have drifted
@@ -480,7 +531,9 @@ class InferenceEngine:
         ``(slot, done)``. The index is reset to ``done + chunk_len``
         (padding KV beyond it is overwritten by the next chunk / decode
         in order, and never attended). Only ONE slot-slice transient
-        exists at a time, however many prefills are in flight."""
+        exists at a time, however many prefills are in flight.
+        ``model`` is a parameter so the draft-model cache (speculative
+        decoding) reuses the same machinery."""
         sax, wax = self._sax, self._wax
         mini = []
         for layer in cache:
@@ -492,7 +545,7 @@ class InferenceEngine:
                     m[key] = jax.lax.dynamic_slice_in_dim(
                         buf, slot, 1, axis=sax)
             mini.append(m)
-        logits, mini = self.model.apply(
+        logits, mini = model.apply(
             {"params": params}, chunk_ids, deterministic=True, cache=mini
         )
         width = chunk_ids.shape[1]
@@ -516,6 +569,17 @@ class InferenceEngine:
         )[:, 0, :]
         return last, new
 
+    @staticmethod
+    def _pin_index(cache, index_vec):
+        """Replace every layer's ``index`` with the host-provided vector
+        (the shared pin/advance idiom of the batched chunk and draft
+        paths — one place to fix if the cache key convention changes)."""
+        return [
+            {k: (index_vec.astype(jnp.int32) if k == "index" else v)
+             for k, v in layer.items()}
+            for layer in cache
+        ]
+
     def _chunk_batch_fn(self, params, cache, chunk_ids, starts, lens):
         """Advance EVERY slot one prefill chunk in a single dispatch,
         operating on the engine cache DIRECTLY — the multi-slot twin of
@@ -538,23 +602,125 @@ class InferenceEngine:
         every row's ``starts[i] + chunk <= cache_len`` (no clamped
         scatter can touch attended rows).
         """
-        pinned = [
-            {k: (starts.astype(jnp.int32) if k == "index" else v)
-             for k, v in layer.items()}
-            for layer in cache
-        ]
         logits, new = self.model.apply(
-            {"params": params}, chunk_ids, deterministic=True, cache=pinned
+            {"params": params}, chunk_ids, deterministic=True,
+            cache=self._pin_index(cache, starts)
         )
-        out = [
-            {k: ((starts + lens).astype(jnp.int32) if k == "index" else v)
-             for k, v in layer.items()}
-            for layer in new
-        ]
+        out = self._pin_index(new, starts + lens)
         last = jnp.take_along_axis(
             logits, jnp.maximum(lens - 1, 0)[:, None, None], axis=1
         )[:, 0, :]
         return last, out
+
+    def _draft_chunk_fn(self, params, cache, chunk_ids, slot, done,
+                        chunk_len):
+        """Chunked feed into the DRAFT cache (catch-up beyond the
+        batched window: initial prompt sync, mostly)."""
+        return self._chunk_slot_impl(self.draft_model, params, cache,
+                                     chunk_ids, slot, done, chunk_len)
+
+    def _draft_roll_fn(self, params, cache, catchup, starts, lens, *,
+                       k: int):
+        """One dispatch: feed each slot's un-synced tokens (``catchup``
+        padded rows, index pinned to ``starts``) through the draft
+        model, then roll ``k`` greedy draft tokens with a ``lax.scan``
+        of single-token decodes. Returns ``(drafts (S, k), cache)``.
+        The returned cache's index is ``starts + lens`` — the rolled
+        tokens' KV beyond it is garbage-for-later, overwritten by the
+        next round's catch-up (overwrite-before-attend, as everywhere
+        else in this engine)."""
+        model = self.draft_model
+        logits, cache2 = model.apply(
+            {"params": params}, catchup, deterministic=True,
+            cache=self._pin_index(cache, starts)
+        )
+        # the catch-up apply advanced every row's index by the PADDED
+        # width W; re-pin to the true filled length before rolling, or
+        # draft tokens 2..k decode at wrong RoPE positions and write
+        # their KV above the watermark (review r5: draft quality
+        # collapsed to ~1 usable token whenever the gap < W)
+        cache2 = self._pin_index(cache2, starts + lens)
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(lens - 1, 0)[:, None, None], axis=1
+        )[:, 0, :]
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        def body(carry, _):
+            cache_c, tok = carry
+            lg, cache_c = model.apply(
+                {"params": params}, tok[:, None], deterministic=True,
+                cache=cache_c)
+            nxt = jnp.argmax(lg[:, 0, :], axis=-1).astype(jnp.int32)
+            return (cache_c, nxt), nxt
+
+        (cache3, _), rest = jax.lax.scan(
+            body, (cache2, first), None, length=k - 1)
+        drafts = jnp.concatenate(
+            [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1)  # (S, k)
+        return drafts, self._pin_index(cache3, starts + lens)
+
+    def _draft_model_propose(self, active: list[int], k: int) -> dict:
+        """Host side of draft-model proposal: re-sync each slot's draft
+        cache to its ``slot_hist`` (chunked for big gaps), then one
+        batched catch-up+roll dispatch. Returns {slot: [k tokens]}."""
+        W = self._draft_window
+        rows = []
+        for s in active:
+            hist = self.slot_hist[s]
+            req = self.slot_req[s]
+            if hist is None or req is None:
+                continue
+            if self._draft_uid[s] != req.uid:     # recycled slot
+                self._draft_uid[s] = req.uid
+                self._draft_sync[s] = 0
+            # the roll writes up to len(hist)+k positions, and the
+            # W-wide catch-up window must also fit — a clamped scatter
+            # near the cache end would shift backward over already-
+            # synced real KV (the idle-row clamp exists for dead rows
+            # only; active rows must be exact, so skip them instead)
+            # tightest post-catch-up watermark is len(hist)-1 (the last
+            # token is always unsynced), so that is the window bound
+            if (len(hist) + k > self.cache_len
+                    or len(hist) - 1 + W > self.cache_len):
+                continue
+            # big gap (initial prompt): chunked feed down to <= W
+            while len(hist) - int(self._draft_sync[s]) > W:
+                done = int(self._draft_sync[s])
+                chunk = hist[done: done + W]
+                padded = np.zeros((1, W), np.int32)
+                padded[0, :len(chunk)] = chunk
+                _, self.draft_cache = self._draft_chunk(
+                    self.draft_params, self.draft_cache,
+                    jnp.asarray(padded), jnp.asarray(s, jnp.int32),
+                    jnp.asarray(done, jnp.int32),
+                    jnp.asarray(len(chunk), jnp.int32))
+                self._draft_sync[s] = done + len(chunk)
+            rows.append(s)
+        if not rows:
+            return {}
+        catchup = np.zeros((self.max_slots, W), np.int32)
+        starts = np.zeros((self.max_slots,), np.int32)
+        lens = np.zeros((self.max_slots,), np.int32)
+        for s in rows:
+            hist = self.slot_hist[s]
+            done = int(self._draft_sync[s])
+            gap = hist[done:]
+            catchup[s, :len(gap)] = gap
+            starts[s] = done
+            lens[s] = len(gap)
+        for s in range(self.max_slots):
+            if s not in rows:                      # idle rows: dead write
+                starts[s] = min(int(self._draft_sync[s]),
+                                self.cache_len - W)
+        drafts, self.draft_cache = self._draft_roll(
+            self.draft_params, self.draft_cache, jnp.asarray(catchup),
+            jnp.asarray(starts), jnp.asarray(lens), k=k)
+        drafts = np.asarray(drafts)
+        out = {}
+        for s in rows:
+            self._draft_sync[s] = len(self.slot_hist[s])
+            out[s] = [int(t) for t in drafts[s]]
+        return out
 
     def _slot_rows_fn(self, cache, slot, bucket: int):
         """Copy ``slot``'s first ``bucket`` KV rows out as a 1-slot rows
@@ -1122,11 +1288,14 @@ class InferenceEngine:
         if not all(self.slot_len[s] + k + 1 <= self.cache_len
                    for s in active):
             return False
-        drafts = {}
-        for s in active:
-            d = self._draft(self.slot_hist[s], k)
-            if d is not None:
-                drafts[s] = d                 # un-padded, 1..k tokens
+        if self.draft_model is not None:
+            drafts = self._draft_model_propose(active, k)
+        else:
+            drafts = {}
+            for s in active:
+                d = self._draft(self.slot_hist[s], k)
+                if d is not None:
+                    drafts[s] = d             # un-padded, 1..k tokens
         if not drafts:
             return False                      # nothing to verify; plain step
         tokens = np.zeros((self.max_slots, k + 1), np.int32)
