@@ -72,6 +72,22 @@ def main():
                    help="decode N tokens per jitted dispatch (vLLM "
                         "multi-step scheduling parity) — the lever when "
                         "host dispatch latency rivals the decode step")
+    p.add_argument("--draft-model-path", dest="draft_model_path",
+                   default=None,
+                   help="checkpoint of a SMALLER model for draft-model "
+                        "speculative decoding (requires --speculative; "
+                        "vLLM speculative_model parity — the ngram "
+                        "speculator runs when this is omitted)")
+    p.add_argument("--max-queue", dest="max_queue", type=int, default=None,
+                   metavar="N",
+                   help="admission control: reject (HTTP 429 queue_full) "
+                        "once N requests wait — ingress backpressure at "
+                        "the engine")
+    p.add_argument("--queue-timeout", dest="queue_timeout", type=float,
+                   default=None, metavar="SECONDS",
+                   help="admission control: shed requests that waited "
+                        "past this deadline (HTTP 429 queue_full) — the "
+                        "gateway's retry policy routes them elsewhere")
     p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype",
                    default="float32", choices=["float32", "bfloat16", "fp8"],
                    help="KV cache storage dtype; fp8 (e4m3) halves KV HBM "
@@ -104,6 +120,17 @@ def main():
                 "adapters merge by unrolled block_i/... kernel paths, "
                 "which do not exist in the stacked tree (they would "
                 "silently serve base weights)")
+    if args.draft_model_path and args.speculative is None:
+        p.error("--draft-model-path requires --speculative K")
+    if args.draft_model_path and args.scan_layers:
+        p.error("--draft-model-path with --scan-layers is not supported "
+                "yet: the draft loads unstacked (cache slot axis 0) while "
+                "the stacked target uses axis 1 — the engine would reject "
+                "the layout mismatch after the full checkpoint restore")
+    if args.draft_model_path and args.tp > 1:
+        p.error("--draft-model-path with --tensor-parallel-size is not "
+                "supported yet: the draft params/KV would sit unsharded "
+                "on one device next to the sharded target")
 
     tok = BPETokenizer.load(args.tokenizer_path)
     if args.quantized_dir:
@@ -179,6 +206,13 @@ def main():
         tiers = "HBM->host" + ("->remote" if args.kv_remote else "")
         print(f"tiered KV pool: {tiers} (namespaced per model)")
 
+    draft_model = draft_params = None
+    if args.draft_model_path:  # combos validated at the argparse block
+        draft_params, draft_meta = ckpt.restore_checkpoint(
+            args.draft_model_path)
+        draft_model = Qwen3(Qwen3Config.from_dict(draft_meta["config"]))
+        print(f"draft model: {args.draft_model_path}")
+
     engine_kw = dict(
         max_slots=args.max_slots, cache_len=args.cache_len,
         eos_id=tok.token_to_id(IM_END),
@@ -188,6 +222,9 @@ def main():
         chunked_prefill=args.chunked_prefill, mesh=mesh,
         speculative_k=args.speculative,
         decode_steps=args.decode_steps,
+        max_queue=args.max_queue,
+        queue_timeout_s=args.queue_timeout,
+        draft_model=draft_model, draft_params=draft_params,
     )
     engine = InferenceEngine(model, params,
                              kv_pool=make_kv_pool(args.model_name),
@@ -199,11 +236,15 @@ def main():
             parse_lora_modules,
         )
 
+        # adapter engines skip the draft: the draft approximates the
+        # BASE distribution, and each copy would cost its own draft KV
+        adapter_kw = {k: v for k, v in engine_kw.items()
+                      if not k.startswith("draft_")}
         adapters = build_adapter_engines(
             model, params, parse_lora_modules(args.lora_modules),
             param_transform=shard_fn,
             engine_kw_for=lambda name: {"kv_pool": make_kv_pool(name)},
-            **engine_kw
+            **adapter_kw
         )
         print(f"adapters: {sorted(adapters)}")
     server = OpenAIServer(engine, tok, model_name=args.model_name,
