@@ -72,6 +72,14 @@ def main():
                    help="decode N tokens per jitted dispatch (vLLM "
                         "multi-step scheduling parity) — the lever when "
                         "host dispatch latency rivals the decode step")
+    p.add_argument("--no-mixed-step", dest="mixed_step",
+                   action="store_false", default=True,
+                   help="disable the fused mixed-batch step (default ON: "
+                        "while prompts chunk-prefill AND slots decode, one "
+                        "dispatch advances every prefill chunk and runs "
+                        "the full decode block — mixed-load steps cost 1 "
+                        "dispatch instead of 2 and decoders keep their "
+                        "--decode-steps amortization)")
     p.add_argument("--draft-model-path", dest="draft_model_path",
                    default=None,
                    help="checkpoint of a SMALLER model for draft-model "
@@ -222,6 +230,7 @@ def main():
         chunked_prefill=args.chunked_prefill, mesh=mesh,
         speculative_k=args.speculative,
         decode_steps=args.decode_steps,
+        mixed_step=args.mixed_step,
         max_queue=args.max_queue,
         queue_timeout_s=args.queue_timeout,
         draft_model=draft_model, draft_params=draft_params,

@@ -41,6 +41,15 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     is a dispatch-latency win on restart.
     """
     global _enabled_dir
+    import jax
+
+    existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if existing and existing != _enabled_dir:
+        # the user/environment already configured a cache directory
+        # (JAX_COMPILATION_CACHE_DIR or a direct jax.config.update):
+        # never clobber it process-wide from a library helper — report
+        # it as the active directory and leave their thresholds alone
+        return existing
     if cache_dir is None:
         cache_dir = os.environ.get("LLM_TPU_COMPILE_CACHE")
         if cache_dir is None:
@@ -71,5 +80,18 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     # admission programs would all miss; cache everything instead
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # Any compile that ran BEFORE this call memoizes the disabled
+        # cache state process-wide (measured: importing the serve
+        # package is enough — enabling afterwards silently wrote zero
+        # entries). reset_cache() drops that memo so the new directory
+        # takes effect for every subsequent compile.
+        from jax.experimental.compilation_cache.compilation_cache import (
+            reset_cache,
+        )
+
+        reset_cache()
+    except Exception:  # pragma: no cover — API location varies by version
+        pass
     _enabled_dir = cache_dir
     return _enabled_dir

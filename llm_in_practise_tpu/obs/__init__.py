@@ -14,6 +14,7 @@ from llm_in_practise_tpu.obs.debug import (  # noqa: F401
     tap,
 )
 from llm_in_practise_tpu.obs.meter import (  # noqa: F401
+    DispatchMeter,
     EpochTimer,
     RollingMean,
     Throughput,

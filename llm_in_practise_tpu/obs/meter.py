@@ -59,6 +59,54 @@ class Throughput:
         return self.step_time.mean
 
 
+class DispatchMeter:
+    """Per-step device-dispatch accounting for the serving engine.
+
+    On a dispatch-taxed host (docs/perf.md Finding 5: ~120 ms tunnel
+    RTT per program launch) the number of jitted-program dispatches per
+    engine step IS the latency model — TPOT ≈ dispatches/step × RTT.
+    This meter makes that number assertable (tests) and scrapeable
+    (/metrics) instead of inferred from wall-clock: the engine wraps
+    every jitted entry point with :meth:`count` and brackets each
+    ``step()`` with :meth:`note_step`.
+
+    Counts engine *program* launches only — host-side eager ops (e.g.
+    the activation-time sampling of a first token) are not programs the
+    step scheduler plans and are deliberately out of scope.
+    """
+
+    def __init__(self, window: int = 50):
+        self.total = 0          # dispatches since engine construction
+        self.steps = 0          # step() iterations observed
+        self.last_step = 0      # dispatches in the most recent step
+        self.per_step = RollingMean(window=window)
+        self._mean = 0.0
+
+    def count(self, n: int = 1) -> None:
+        self.total += int(n)
+
+    def wrap(self, fn):
+        """Wrap a jitted callable so every invocation counts as one
+        dispatch."""
+        def counted(*args, **kwargs):
+            self.count()
+            return fn(*args, **kwargs)
+        counted.__wrapped__ = fn
+        return counted
+
+    def note_step(self, dispatches: int) -> None:
+        self.steps += 1
+        self.last_step = int(dispatches)
+        # the rolling deque is touched by the engine thread only; the
+        # cached float is what /metrics scraper threads read (iterating
+        # the deque there could race a concurrent append)
+        self._mean = self.per_step.update(dispatches)
+
+    @property
+    def mean_per_step(self) -> float:
+        return self._mean
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: str | None):
     """``with profile_trace("/tmp/trace"):`` — jax.profiler trace around the

@@ -136,10 +136,26 @@ def save_packed(out_dir: str, qtree, *, metadata: dict | None = None) -> str:
     return mpath
 
 
+_MAX_MANIFEST_FORMAT = 2
+
+
 def load_packed(out_dir: str):
-    """Read a packed tree back: ``(qtree, metadata)``."""
+    """Read a packed tree back: ``(qtree, metadata)``.
+
+    Raises on manifest ``format`` versions newer than this reader
+    understands: a future format may key arrays differently (format 2
+    itself moved bf16 tagging from per-leaf to per-array), and loading
+    one with old rules would silently rebuild garbage uint16 weights
+    instead of failing loudly.
+    """
     with open(os.path.join(out_dir, "manifest.json")) as f:
         manifest = json.load(f)
+    fmt = int(manifest.get("format", 1))
+    if fmt > _MAX_MANIFEST_FORMAT:
+        raise ValueError(
+            f"{out_dir}: packed manifest format {fmt} is newer than this "
+            f"reader understands (<= {_MAX_MANIFEST_FORMAT}) — upgrade "
+            "llm_in_practise_tpu or re-export the artifact")
     with np.load(os.path.join(out_dir, "packed.npz")) as npz:
         arrays = {k: npz[k] for k in npz.files}
     bf16_names = frozenset(manifest.get("bf16_arrays", ()))

@@ -196,15 +196,28 @@ class OpenAIServer:
         if handle.finish_reason == "queue_full":
             return queue_full_429("engine queue full")
 
-        if req.stream:
-            from llm_in_practise_tpu.serve.engine import _FINISH
+        from llm_in_practise_tpu.serve.engine import _FINISH, EngineDeadError
 
+        def engine_dead_503():
+            return send_json(503, {"error": {
+                "message": "engine is not running — request cannot be "
+                           "served; retry against another replica",
+                "type": "internal_error",
+                "code": "engine_dead",
+            }})
+
+        if req.stream:
             # hold the 200 until the request survives admission: a
             # queue_timeout shed must surface as a retriable 429, not a
             # silently empty SSE stream. Blocks until the first token
             # (or finish) — exactly when the first data chunk could be
-            # sent anyway, so client-visible TTFT is unchanged.
-            first = handle.tokens.get()
+            # sent anyway, so client-visible TTFT is unchanged. The
+            # wait is liveness-bounded (Request.next_item): a dead
+            # engine is a 503, not a client hanging with no headers.
+            try:
+                first = handle.next_item()
+            except EngineDeadError:
+                return engine_dead_503()
             if first is _FINISH and handle.finish_reason == "queue_full":
                 return queue_full_429("request timed out waiting for a slot")
 
@@ -215,9 +228,13 @@ class OpenAIServer:
                 tokens, prev_text = [], ""
 
                 def stream_toks():
-                    if first is not _FINISH:
-                        yield first
-                        yield from handle
+                    # mid-stream liveness: headers are out, so a dead
+                    # engine propagates EngineDeadError into _sse's
+                    # in-band error event instead of freezing the stream
+                    tok = first
+                    while tok is not _FINISH:
+                        yield tok
+                        tok = handle.next_item()
                 for tok in stream_toks():
                     tokens.append(tok)
                     text = self.tokenizer.decode(tokens)
@@ -232,7 +249,10 @@ class OpenAIServer:
                 )
             return send_stream(chunks())
 
-        out_ids = handle.result()
+        try:
+            out_ids = handle.result()
+        except EngineDeadError:
+            return engine_dead_503()
         if handle.finish_reason == "queue_full":  # queue_timeout shed
             return queue_full_429("request timed out waiting for a slot")
         text = self.tokenizer.decode(out_ids)
@@ -258,6 +278,19 @@ class OpenAIServer:
                 "# TYPE llm_requests_shed_total counter",
                 f"llm_requests_shed_total {s.requests_shed}",
             ]
+        # dispatch accounting (docs/perf.md Findings 5/16/17): on a
+        # dispatch-taxed host, dispatches/step IS the latency model —
+        # the fused mixed step's win shows up here as ~1.0 under
+        # simultaneous prefill+decode (it was 2 before)
+        dm = self.engine.dispatch_meter
+        lines += [
+            "# TYPE llm_dispatches_total counter",
+            f"llm_dispatches_total {dm.total}",
+            "# TYPE llm_dispatches_per_step gauge",
+            f"llm_dispatches_per_step {dm.mean_per_step:.3f}",
+            "# TYPE llm_mixed_blocks_total counter",
+            f"llm_mixed_blocks_total {self.engine.mixed_blocks}",
+        ]
         for name, vals in (("llm_ttft_seconds", ttft), ("llm_tpot_seconds", tpot)):
             lines += [
                 f"# TYPE {name} summary",

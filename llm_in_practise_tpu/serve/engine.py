@@ -44,6 +44,15 @@ import numpy as np
 
 from llm_in_practise_tpu.infer.generate import max_positions
 from llm_in_practise_tpu.infer.sampling import sample_token_batched
+from llm_in_practise_tpu.obs.logging import get_logger
+from llm_in_practise_tpu.obs.meter import DispatchMeter
+from llm_in_practise_tpu.serve.mixed_step import (
+    batched_chunk,
+    decode_scan,
+    make_mixed_step,
+    pin_index,
+    plan_decode_block,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +69,10 @@ class SamplingParams:
 _FINISH = object()  # sentinel closing a request's token queue
 
 
+class EngineDeadError(RuntimeError):
+    """The engine loop died while a request waited on its token queue."""
+
+
 @dataclasses.dataclass
 class Request:
     """A submitted generation request and its streaming output channel."""
@@ -73,11 +86,31 @@ class Request:
     finish_time: float | None = None
     finish_reason: str | None = None
     n_generated: int = 0
+    # set by submit(); lets every queue consumer bound its wait with a
+    # liveness check instead of blocking forever on a dead engine
+    engine: "InferenceEngine | None" = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def next_item(self, poll_s: float = 1.0):
+        """Next queue item — a token id or the internal finish sentinel
+        (compare with ``is`` against ``_FINISH``). The wait is BOUNDED:
+        between ``poll_s`` polls the engine's liveness is checked, so a
+        crashed/stopped engine raises :class:`EngineDeadError` instead
+        of freezing the consumer thread (the API layer maps it to a
+        5xx; benches/scripts see the exception)."""
+        while True:
+            try:
+                return self.tokens.get(timeout=poll_s)
+            except queue.Empty:
+                if self.engine is not None and not self.engine.is_alive():
+                    raise EngineDeadError(
+                        "engine loop is not running; request "
+                        f"{self.uid} will never finish")
 
     def __iter__(self):
         """Yield generated token ids until the request finishes."""
         while True:
-            item = self.tokens.get()
+            item = self.next_item()
             if item is _FINISH:
                 return
             yield item
@@ -156,6 +189,7 @@ class InferenceEngine:
         speculative_ngram: int = 3,
         decode_steps: int = 1,
         prefill_budget: int = 1,
+        mixed_step: bool = True,
         max_queue: int | None = None,
         queue_timeout_s: float | None = None,
         draft_model=None,
@@ -340,17 +374,31 @@ class InferenceEngine:
         # (a lax.scan), paying host-dispatch overhead once per block.
         # This is the lever when dispatch latency rivals step time —
         # weak hosts, remote-tunnel setups; on a fast local host 1 is
-        # fine. Used only when the queue is empty and no prefill is in
-        # flight (a block delays admission by its length), and never
-        # combined with speculative decoding (spec already batches).
-        # Slots that finish mid-block waste their remaining rows; the
-        # freed slot's rows/index are reset on reuse by the insert path
-        # (the same contract the speculative burst relies on).
+        # fine. Block length is planned per step by
+        # :func:`llm_in_practise_tpu.serve.mixed_step.plan_decode_block`
+        # (soonest-completion cap under queueing, chunk-window caps while
+        # prompts prefill); it is never combined with speculative
+        # decoding (spec already batches). Slots that finish mid-block
+        # waste their remaining rows; the freed slot's rows/index are
+        # reset on reuse by the insert path (the same contract the
+        # speculative burst relies on).
         if decode_steps < 1:
             raise ValueError(f"decode_steps must be >= 1, got {decode_steps}")
         self.decode_steps = decode_steps
         self.multi_blocks = 0
         self.multi_steps_total = 0  # decode iterations spent inside blocks
+        # Fused mixed-batch step (r6): while prompts are mid-chunked-
+        # prefill AND slots are decoding, ONE jitted program advances
+        # every prefill row a chunk and runs the decode block — mixed-
+        # load steps cost 1 dispatch instead of 2, and decoders keep
+        # their n>1 amortization instead of degrading to single-token
+        # dispatches (the r5 long-context TPOT collapse; see
+        # serve/mixed_step.py and docs/perf.md Finding 17).
+        self.mixed_step = bool(mixed_step)
+        self.mixed_blocks = 0
+        self._log = get_logger("serve.engine")
+        self._spec_suspended_logged = False
+        self._mixed_fallbacks_logged: set[str] = set()
         # Guaranteed chunked-prefill budget: every engine step runs up to
         # this many prefill chunks BEFORE any decode work, so decode load
         # can never starve a prompt that is mid-prefill (the TTFT-fairness
@@ -362,31 +410,42 @@ class InferenceEngine:
             )
         self.prefill_budget = prefill_budget
 
-        self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
-        self._decode_multi = jax.jit(self._decode_multi_fn,
-                                     donate_argnums=(1,),
-                                     static_argnames=("n",))
-        self._decode_spec = jax.jit(self._decode_spec_fn, donate_argnums=(1,))
-        self._rewind = jax.jit(self._rewind_fn, donate_argnums=(0,))
-        self._prefill = jax.jit(self._prefill_fn)
-        self._prefill_suffix = jax.jit(self._prefill_suffix_fn)
-        self._insert = jax.jit(self._insert_fn, donate_argnums=(0,),
-                               static_argnames=("slot",))
-        self._insert_batch = jax.jit(self._insert_batch_fn,
-                                     donate_argnums=(0,))
-        self._insert_rows = jax.jit(self._insert_rows_fn, donate_argnums=(0,),
-                                    static_argnames=("slot",))
-        self._chunk_slot = jax.jit(self._chunk_slot_fn, donate_argnums=(1,))
-        self._chunk_batch = jax.jit(self._chunk_batch_fn,
-                                    donate_argnums=(1,))
-        self._slot_rows = jax.jit(self._slot_rows_fn,
-                                  static_argnames=("bucket",))
+        # Dispatch accounting: every jitted engine program is wrapped so
+        # /metrics (llm_dispatches_*) and the mixed-step tests can assert
+        # dispatches/step instead of inferring it from wall-clock.
+        self.dispatch_meter = DispatchMeter()
+        _c = self.dispatch_meter.wrap
+        self._decode = _c(jax.jit(self._decode_fn, donate_argnums=(1,)))
+        self._decode_multi = _c(jax.jit(self._decode_multi_fn,
+                                        donate_argnums=(1,),
+                                        static_argnames=("n",)))
+        self._decode_spec = _c(jax.jit(self._decode_spec_fn,
+                                       donate_argnums=(1,)))
+        self._rewind = _c(jax.jit(self._rewind_fn, donate_argnums=(0,)))
+        self._prefill = _c(jax.jit(self._prefill_fn))
+        self._prefill_suffix = _c(jax.jit(self._prefill_suffix_fn))
+        self._insert = _c(jax.jit(self._insert_fn, donate_argnums=(0,),
+                                  static_argnames=("slot",)))
+        self._insert_batch = _c(jax.jit(self._insert_batch_fn,
+                                        donate_argnums=(0,)))
+        self._insert_rows = _c(jax.jit(self._insert_rows_fn,
+                                       donate_argnums=(0,),
+                                       static_argnames=("slot",)))
+        self._chunk_slot = _c(jax.jit(self._chunk_slot_fn,
+                                      donate_argnums=(1,)))
+        self._chunk_batch = _c(jax.jit(self._chunk_batch_fn,
+                                       donate_argnums=(1,)))
+        self._slot_rows = _c(jax.jit(self._slot_rows_fn,
+                                     static_argnames=("bucket",)))
+        self._mixed = _c(jax.jit(make_mixed_step(model),
+                                 donate_argnums=(1,),
+                                 static_argnames=("n",)))
         if draft_model is not None:
-            self._draft_chunk = jax.jit(self._draft_chunk_fn,
-                                        donate_argnums=(1,))
-            self._draft_roll = jax.jit(self._draft_roll_fn,
-                                       donate_argnums=(1,),
-                                       static_argnames=("k",))
+            self._draft_chunk = _c(jax.jit(self._draft_chunk_fn,
+                                           donate_argnums=(1,)))
+            self._draft_roll = _c(jax.jit(self._draft_roll_fn,
+                                          donate_argnums=(1,),
+                                          static_argnames=("k",)))
 
     # --- jitted pieces -------------------------------------------------------
 
@@ -430,24 +489,10 @@ class InferenceEngine:
         """``n`` single-token decodes under one lax.scan — one compiled
         program, one dispatch. Returns ((B, n) tokens, cache). ``n`` is
         static (≤ ``decode_steps`` distinct compilations): blocks shrink
-        when a slot is about to finish and requests are waiting."""
-
-        def body(carry, key):
-            tok, cache = carry
-            logits, cache = self.model.apply(
-                {"params": params}, tok[:, None], deterministic=True,
-                cache=cache,
-            )
-            nxt = sample_token_batched(
-                key, logits[:, -1, :].astype(jnp.float32),
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                greedy=greedy,
-            ).astype(jnp.int32)
-            return (nxt, cache), nxt
-
-        keys = jax.random.split(rng, n)
-        (_, cache), toks = jax.lax.scan(body, (tokens, cache), keys)
-        return toks.T, cache                     # (B, n)
+        when a slot is about to finish and requests are waiting. Body
+        shared with the fused mixed step (serve/mixed_step.py)."""
+        return decode_scan(self.model, params, cache, tokens, rng,
+                           temperature, top_k, top_p, greedy, n=n)
 
     def _decode_spec_fn(self, params, cache, tokens):
         """Verify step: tokens (B, K+1); returns greedy continuations at
@@ -569,16 +614,9 @@ class InferenceEngine:
         )[:, 0, :]
         return last, new
 
-    @staticmethod
-    def _pin_index(cache, index_vec):
-        """Replace every layer's ``index`` with the host-provided vector
-        (the shared pin/advance idiom of the batched chunk and draft
-        paths — one place to fix if the cache key convention changes)."""
-        return [
-            {k: (index_vec.astype(jnp.int32) if k == "index" else v)
-             for k, v in layer.items()}
-            for layer in cache
-        ]
+    # shared pin/advance idiom of the batched chunk, draft, and fused
+    # mixed-step paths — single definition in serve/mixed_step.py
+    _pin_index = staticmethod(pin_index)
 
     def _chunk_batch_fn(self, params, cache, chunk_ids, starts, lens):
         """Advance EVERY slot one prefill chunk in a single dispatch,
@@ -600,17 +638,11 @@ class InferenceEngine:
         non-prefill rows), so the returned index ``starts + lens``
         advances exactly the prefilling rows. The caller guarantees
         every row's ``starts[i] + chunk <= cache_len`` (no clamped
-        scatter can touch attended rows).
+        scatter can touch attended rows). Body shared with the fused
+        mixed step (serve/mixed_step.py).
         """
-        logits, new = self.model.apply(
-            {"params": params}, chunk_ids, deterministic=True,
-            cache=self._pin_index(cache, starts)
-        )
-        out = self._pin_index(new, starts + lens)
-        last = jnp.take_along_axis(
-            logits, jnp.maximum(lens - 1, 0)[:, None, None], axis=1
-        )[:, 0, :]
-        return last, out
+        return batched_chunk(self.model, params, cache, chunk_ids,
+                             starts, lens)
 
     def _draft_chunk_fn(self, params, cache, chunk_ids, slot, done,
                         chunk_len):
@@ -682,6 +714,15 @@ class InferenceEngine:
             # token is always unsynced), so that is the window bound
             if (len(hist) + k > self.cache_len
                     or len(hist) - 1 + W > self.cache_len):
+                # This slot now falls into the idle-row clamped dead
+                # write below, which may overwrite its already-synced
+                # draft KV near the cache tail. That is safe only while
+                # the skip is permanent — so enforce the invariant:
+                # drop the watermark, and any future re-admission of
+                # this slot forces a full KV re-sync instead of
+                # attending the clamped dead-write's corrupted rows
+                # (ADVICE.md round 5).
+                self._draft_uid[s] = -1
                 continue
             # big gap (initial prompt): chunked feed down to <= W
             while len(hist) - int(self._draft_sync[s]) > W:
@@ -816,7 +857,7 @@ class InferenceEngine:
         max_prompt = self.cache_len - 2
         if len(prompt_ids) > max_prompt:  # sliding-window crop (reference
             prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
-        req = Request(next(self._uid), prompt_ids, params)
+        req = Request(next(self._uid), prompt_ids, params, engine=self)
         with self.stats.lock:
             self.stats.requests_total += 1
         with self._submit_lock:
@@ -1120,23 +1161,7 @@ class InferenceEngine:
                 and self.slot_req[s] is not None  # free rows are dead
             )
             if batchable:
-                tok = np.zeros((self.max_slots, C), np.int32)
-                starts = np.zeros((self.max_slots,), np.int32)
-                lens = np.zeros((self.max_slots,), np.int32)
-                for s in range(self.max_slots):
-                    if s in self.slot_prefill:
-                        continue
-                    # non-prefill rows: zero tokens at the row's own
-                    # index — garbage KV beyond it, overwritten in
-                    # order. min() keeps the dead write window of FREE
-                    # rows inside the cache (active rows already fit by
-                    # the batchable check).
-                    starts[s] = min(int(self.slot_len[s]),
-                                    self.cache_len - C)
-                for slot, st, chunk in entries:
-                    tok[slot, :len(chunk)] = chunk
-                    starts[slot] = st["done"]
-                    lens[slot] = len(chunk)
+                tok, starts, lens = self._chunk_batch_rows(entries)
                 last, self.cache = self._chunk_batch(
                     self.params, self.cache, jnp.asarray(tok),
                     jnp.asarray(starts), jnp.asarray(lens))
@@ -1156,23 +1181,51 @@ class InferenceEngine:
                     st["done"] += len(chunk)
             budget -= 1
             progressed = True
-            for slot in list(self.slot_prefill):
-                st = self.slot_prefill[slot]
-                if st["done"] < st["plen"]:
-                    continue
-                req, plen = st["req"], st["plen"]
-                del self.slot_prefill[slot]
-                # rows are already in the slot; store the prefix entry
-                # from them (the index is plen — set by the final chunk)
-                if self.prefix_cache is not None:
-                    rows = self._slot_rows(
-                        self.cache, jnp.asarray(slot, jnp.int32),
-                        bucket=self._bucket_for(plen))
-                    self._store_prefix(req, plen, rows,
-                                       st["last_logits"],
-                                       rows_ready=True)
-                self._activate(slot, req, plen, st["last_logits"])
+            self._finalize_prefills()
         return progressed
+
+    def _chunk_batch_rows(self, entries):
+        """Host arrays (tok, starts, lens) for a whole-cache batched
+        chunk dispatch — shared by the sequential batched path and the
+        fused mixed step. Non-prefill rows get zero tokens at their own
+        index: garbage KV beyond it, overwritten in order before any
+        query attends it; min() keeps the dead write window of FREE
+        rows inside the cache (occupied rows already fit by the
+        caller's precheck — ``batchable`` / ``_mixed_feasible`` — so
+        their min() is a no-op)."""
+        C = self.chunked_prefill
+        tok = np.zeros((self.max_slots, C), np.int32)
+        starts = np.zeros((self.max_slots,), np.int32)
+        lens = np.zeros((self.max_slots,), np.int32)
+        for s in range(self.max_slots):
+            if s not in self.slot_prefill:
+                starts[s] = min(int(self.slot_len[s]),
+                                self.cache_len - C)
+        for slot, st, chunk in entries:
+            tok[slot, :len(chunk)] = chunk
+            starts[slot] = st["done"]
+            lens[slot] = len(chunk)
+        return tok, starts, lens
+
+    def _finalize_prefills(self) -> None:
+        """Activate every chunked prefill whose prompt is fully fed —
+        shared tail of the sequential and fused mixed-step paths."""
+        for slot in list(self.slot_prefill):
+            st = self.slot_prefill[slot]
+            if st["done"] < st["plen"]:
+                continue
+            req, plen = st["req"], st["plen"]
+            del self.slot_prefill[slot]
+            # rows are already in the slot; store the prefix entry
+            # from them (the index is plen — set by the final chunk)
+            if self.prefix_cache is not None:
+                rows = self._slot_rows(
+                    self.cache, jnp.asarray(slot, jnp.int32),
+                    bucket=self._bucket_for(plen))
+                self._store_prefix(req, plen, rows,
+                                   st["last_logits"],
+                                   rows_ready=True)
+            self._activate(slot, req, plen, st["last_logits"])
 
     def _store_prefix(self, req: Request, plen: int, pre_cache,
                       last_logits, *, rows_ready: bool = False) -> None:
@@ -1275,18 +1328,34 @@ class InferenceEngine:
                     return cont              # un-padded; caller zero-fills
         return None
 
-    def _try_speculative(self, active: list[int]) -> bool:
-        """Run one verify-step over drafted tokens; returns False when the
-        spec path doesn't apply this step (caller falls back to decode)."""
+    def _spec_applicable(self, active: list[int]) -> bool:
+        """Whether the speculative verify step CAN run this step —
+        shared by :meth:`_try_speculative` and the mixed-step
+        composition decision (the two must never diverge: composition
+        skips the fused dispatch on the promise that a verify runs
+        instead)."""
         k = self.speculative_k
         if k is None:
             return False
         if not all(self._greedy[s] for s in active):
             return False                      # lossless only under greedy
         # every write of the wide step must land inside the cache — the
-        # per-slot scatter clamps at the end and would corrupt tail rows
-        if not all(self.slot_len[s] + k + 1 <= self.cache_len
-                   for s in active):
+        # per-slot scatter clamps at the end and would corrupt tail
+        # rows. That bound applies to mid-prefill rows too: the verify
+        # writes k+1 dead rows at each one's device index (= done), and
+        # a clamp there would shift backward over already-attended
+        # prompt KV (in-bounds dead writes are fine — the owning chunk
+        # overwrites them before any query attends).
+        return (all(self.slot_len[s] + k + 1 <= self.cache_len
+                    for s in active)
+                and all(st["done"] + k + 1 <= self.cache_len
+                        for st in self.slot_prefill.values()))
+
+    def _try_speculative(self, active: list[int]) -> bool:
+        """Run one verify-step over drafted tokens; returns False when the
+        spec path doesn't apply this step (caller falls back to decode)."""
+        k = self.speculative_k
+        if not self._spec_applicable(active):
             return False
         if self.draft_model is not None:
             drafts = self._draft_model_propose(active, k)
@@ -1334,84 +1403,233 @@ class InferenceEngine:
             self.slot_hist[slot].append(tok)
         self._emit(slot, tok)
 
+    def _update_active_stats(self) -> None:
+        with self.stats.lock:
+            self.stats.active_slots = sum(
+                r is not None for r in self.slot_req)
+
+    def _ready_slots(self) -> list[int]:
+        return [s for s, r in enumerate(self.slot_req)
+                if r is not None and self.slot_ready[s]]
+
+    def _plan_block(self, active: list[int]) -> int:
+        """Token-budget plan for this step's decode block length: the
+        soonest-completion cap under queueing plus (while prompts are
+        mid-prefill) the chunk-window caps — policy in
+        :func:`llm_in_practise_tpu.serve.mixed_step.plan_decode_block`."""
+        soonest = None
+        if active and self.pending.qsize() > 0:
+            # Requests are waiting on a slot: cap the block at the
+            # soonest *deterministic* completion among active slots
+            # (token budget or cache room, whichever bites first), so
+            # the freed slot refills at the very next step instead of
+            # idling out the tail of a fixed-length block. This is the
+            # TTFT half of multi-step scheduling: full blocks when
+            # nobody waits, shortest-useful blocks under queueing.
+            soonest = int(min(
+                min(self.slot_budget[s],
+                    self.cache_len - 1 - self.slot_len[s])
+                for s in active
+            ))
+        chunk = headroom = None
+        if self.slot_prefill:
+            chunk = self.chunked_prefill
+            headroom = min(
+                self.cache_len - chunk - st["done"]
+                for st in self.slot_prefill.values())
+        return plan_decode_block(
+            decode_steps=self.decode_steps,
+            queue_depth=self.pending.qsize(),
+            soonest_finish=soonest,
+            chunk=chunk,
+            prefill_headroom=headroom,
+        )
+
+    def _mixed_feasible(self, active: list[int], n: int) -> tuple[bool, str]:
+        """Can this step run as ONE fused dispatch? The bounds are the
+        scatter-clamp invariants documented in serve/mixed_step.py; a
+        miss falls back to the sequential two-dispatch path (rare tail:
+        rows butting against the cache end)."""
+        C = self.chunked_prefill
+        if n > C:
+            # the scan's garbage rows above each prefill watermark must
+            # be covered by the next chunk's padded write; the planner
+            # already caps n <= chunk, this keeps the invariant local
+            return False, (
+                f"block length exceeds the chunk window: n {n} > "
+                f"chunk {C}")
+        for slot, st in self.slot_prefill.items():
+            if st["done"] + C + n > self.cache_len:
+                return False, (
+                    "prefill row near the cache end: "
+                    f"slot {slot} done {st['done']} + chunk {C} + "
+                    f"block {n} > cache_len {self.cache_len}")
+        for s in range(self.max_slots):
+            # every occupied non-prefill row receives the dead chunk
+            # write at its own index (free rows clamp; occupied rows
+            # must fit exactly) — same bound as the batched chunk path
+            if s in self.slot_prefill or self.slot_req[s] is None:
+                continue
+            if int(self.slot_len[s]) + C > self.cache_len:
+                return False, (
+                    "decode row lacks the chunk dead-write window: "
+                    f"slot {s} len {int(self.slot_len[s])} + chunk {C} "
+                    f"> cache_len {self.cache_len}")
+        return True, ""
+
+    def _mixed_dispatch(self, active: list[int], n: int) -> None:
+        """Issue the fused mixed-batch program: every mid-prefill row
+        advances one chunk AND every ready row decodes an ``n``-block,
+        in ONE device dispatch (serve/mixed_step.py). Host bookkeeping
+        mirrors the sequential paths exactly: chunk results feed
+        ``slot_prefill``/finalization, block tokens commit per slot."""
+        C = self.chunked_prefill
+        entries = []
+        for slot in sorted(self.slot_prefill):
+            st = self.slot_prefill[slot]
+            chunk = st["req"].prompt_ids[st["done"]: st["done"] + C]
+            entries.append((slot, st, chunk))
+        tok, starts, lens = self._chunk_batch_rows(entries)
+        advance = np.zeros((self.max_slots,), np.int32)
+        advance[active] = n
+        self.rng, sub = jax.random.split(self.rng)
+        chunk_last, toks, self.cache = self._mixed(
+            self.params, self.cache, jnp.asarray(tok),
+            jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(advance),
+            jnp.asarray(self.slot_last_token), sub,
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+            jnp.asarray(self._greedy),
+            n=n,
+        )
+        self.mixed_blocks += 1
+        for slot, st, chunk in entries:
+            st["last_logits"] = chunk_last[slot:slot + 1]
+            st["done"] += len(chunk)
+        self._finalize_prefills()
+        self._commit_block(active, np.asarray(toks), n)
+
+    def _commit_block(self, active: list[int], toks_host, n: int) -> None:
+        """Book an ``n``-step decode block's tokens ((B, n) host array)
+        into every active slot — shared by the fused mixed step and the
+        sequential multi-step path, so the two dispatch modes commit
+        (and stop at mid-block finishes) identically."""
+        if n > 1:
+            self.multi_blocks += 1
+            self.multi_steps_total += n
+        for slot in active:
+            for j in range(n):
+                if self.slot_req[slot] is None:
+                    break                 # finished mid-block (eos/len)
+                self._commit_token(slot, int(toks_host[slot, j]))
+
     def step(self) -> bool:
         """One engine iteration. Returns False when fully idle."""
         with self._lock:
-            self._admit()
-            progressed = self._advance_prefills(self.prefill_budget)
-            active = [s for s, r in enumerate(self.slot_req)
-                      if r is not None and self.slot_ready[s]]
-            if not active:
-                return progressed or bool(self.slot_prefill)
-            if self._try_speculative(active):
-                with self.stats.lock:
-                    self.stats.active_slots = sum(
-                        r is not None for r in self.slot_req)
-                return True
-            self.rng, sub = jax.random.split(self.rng)
-            n = self.decode_steps
-            # a block delays admission by its length, so only run it when
-            # admission couldn't happen anyway: queue empty OR no free
-            # slot for a waiting request to land in
-            admission_possible = (
-                self.pending.qsize() > 0
-                and any(r is None for r in self.slot_req)
-            )
-            if n > 1 and self.pending.qsize() > 0:
-                # Requests are waiting on a slot: cap the block at the
-                # soonest *deterministic* completion among active slots
-                # (token budget or cache room, whichever bites first), so
-                # the freed slot refills at the very next step instead of
-                # idling out the tail of a fixed-length block. This is the
-                # TTFT half of multi-step scheduling: full blocks when
-                # nobody waits, shortest-useful blocks under queueing.
-                soonest = int(min(
-                    min(self.slot_budget[s],
-                        self.cache_len - 1 - self.slot_len[s])
-                    for s in active
-                ))
-                n = max(1, min(n, soonest))
-                # quantize the capped length DOWN to a power of two:
-                # every distinct n is its own compiled program, and an
-                # uncapped 1..decode_steps range lets a first-seen n=5
-                # land a multi-second compile inside a latency-SLA
-                # request (measured: a 703 ms-mean-TPOT outlier in an
-                # otherwise 70 ms ladder). Pow2 bounds the variants to
-                # log2(decode_steps)+1, all reachable by warmup.
-                n = 1 << (n.bit_length() - 1)
-            use_multi = (
-                n > 1
-                and self.speculative_k is None
-                and not admission_possible
-                and not self.slot_prefill
-                # every row the block writes must land inside the cache
-                and all(self.slot_len[s] + n <= self.cache_len
-                        for s in active)
-            )
-            if use_multi:
-                toks, self.cache = self._decode_multi(
-                    self.params, self.cache,
-                    jnp.asarray(self.slot_last_token),
-                    sub,
-                    jnp.asarray(self._temperature),
-                    jnp.asarray(self._top_k),
-                    jnp.asarray(self._top_p),
-                    jnp.asarray(self._greedy),
-                    n=n,
-                )
-                toks_host = np.asarray(toks)
-                self.multi_blocks += 1
-                self.multi_steps_total += n
-                for slot in active:
-                    for j in range(n):
-                        if self.slot_req[slot] is None:
-                            break             # finished mid-block (eos/len)
-                        self._commit_token(slot, int(toks_host[slot, j]))
-                with self.stats.lock:
-                    self.stats.active_slots = sum(
-                        r is not None for r in self.slot_req)
-                return True
-            next_tok, self.cache = self._decode(
+            before = self.dispatch_meter.total
+            busy = False
+            try:
+                busy = self._step_locked()
+                return busy
+            finally:
+                spent = self.dispatch_meter.total - before
+                # idle background-loop polls (~10 Hz while waiting on
+                # _wake) must not record 0-dispatch steps, or the
+                # per-step rolling mean decays to 0 on any bursty
+                # server and the metric stops meaning anything
+                if busy or spent:
+                    self.dispatch_meter.note_step(spent)
+
+    def _step_locked(self) -> bool:
+        self._admit()
+        budget = self.prefill_budget
+        active = self._ready_slots()
+        # A speculative engine left at decode_steps=1 keeps speculating
+        # while prompts prefill (the r5 composition): its verify step
+        # yields 1+accepted tokens per dispatch, strictly more than the
+        # fused step's single token at n=1 — suspending it there would
+        # REGRESS mixed-load TPOT on accepting workloads. With
+        # decode_steps>1 the fused block's amortization wins and spec
+        # is suspended below (greedy-lossless either way). Composition
+        # only applies when speculation actually CAN run this step —
+        # non-greedy traffic on a spec engine must not lose the fused
+        # step too.
+        spec_composes = (
+            self.decode_steps == 1
+            and self._spec_applicable(active)
+            # the verify runs AFTER this step's chunks advance each
+            # prefill row (by up to budget chunks) — account for that
+            # movement here, or near the cache tail the composition
+            # promise breaks: the feasible fused dispatch is skipped
+            # and _try_speculative then declines post-advance, leaving
+            # 2 dispatches for 1 token
+            and all(st["done"] + budget * self.chunked_prefill
+                    + self.speculative_k + 1 <= self.cache_len
+                    for st in self.slot_prefill.values())
+        )
+        pre_progress = False
+        if (self.mixed_step and self.slot_prefill and active
+                and not spec_composes):
+            # Fused mixed-batch step: prefill chunks + the decode block
+            # in ONE dispatch, so decoders keep their n>1 amortization
+            # while prompts prefill (r5: forcing n=1 here collapsed
+            # conc-4 long-context TPOT p99 from ~67 ms to 315 ms).
+            if budget > 1:
+                # the fused program carries ONE chunk per dispatch;
+                # spend the rest of the guaranteed prefill budget
+                # sequentially first so the TTFT bound
+                # (ceil(chunks/budget) steps) still holds — and
+                # re-snapshot the ready set, since a prompt finishing
+                # its last chunk here activates and must join this
+                # step's decode block (sequential-path parity)
+                pre_progress = self._advance_prefills(budget - 1)
+                budget = 1
+                active = self._ready_slots()
+            if self.slot_prefill and active:
+                n = self._plan_block(active)
+                ok, why = self._mixed_feasible(active, n)
+                if ok:
+                    if (self.speculative_k is not None
+                            and not self._spec_suspended_logged):
+                        self._spec_suspended_logged = True
+                        self._log.info(
+                            "speculative decoding suspended while a "
+                            "prompt is mid-prefill: the fused mixed "
+                            "step runs plain decode blocks (greedy "
+                            "outputs are unchanged — spec is lossless); "
+                            "speculation resumes when no prefill is in "
+                            "flight")
+                    self._mixed_dispatch(active, n)
+                    self._update_active_stats()
+                    return True
+                # log each fallback KIND once (the detail after ':'
+                # varies per occurrence; keying the dedup on it would
+                # grow without bound on a long-running server)
+                kind = why.split(":", 1)[0]
+                if kind not in self._mixed_fallbacks_logged:
+                    self._mixed_fallbacks_logged.add(kind)
+                    self._log.info(
+                        "fused mixed step fell back to sequential "
+                        "dispatches: %s", why)
+        progressed = self._advance_prefills(budget) or pre_progress
+        active = self._ready_slots()
+        if not active:
+            return progressed or bool(self.slot_prefill)
+        if self._try_speculative(active):
+            self._update_active_stats()
+            return True
+        self.rng, sub = jax.random.split(self.rng)
+        n = self._plan_block(active)
+        use_multi = (
+            n > 1
+            and self.speculative_k is None     # spec already batches
+            # every row the block writes must land inside the cache
+            and all(self.slot_len[s] + n <= self.cache_len
+                    for s in active)
+        )
+        if use_multi:
+            toks, self.cache = self._decode_multi(
                 self.params, self.cache,
                 jnp.asarray(self.slot_last_token),
                 sub,
@@ -1419,13 +1637,25 @@ class InferenceEngine:
                 jnp.asarray(self._top_k),
                 jnp.asarray(self._top_p),
                 jnp.asarray(self._greedy),
+                n=n,
             )
-            next_host = np.asarray(next_tok)
-            for slot in active:
-                self._commit_token(slot, int(next_host[slot]))
-            with self.stats.lock:
-                self.stats.active_slots = sum(r is not None for r in self.slot_req)
+            self._commit_block(active, np.asarray(toks), n)
+            self._update_active_stats()
             return True
+        next_tok, self.cache = self._decode(
+            self.params, self.cache,
+            jnp.asarray(self.slot_last_token),
+            sub,
+            jnp.asarray(self._temperature),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+            jnp.asarray(self._greedy),
+        )
+        next_host = np.asarray(next_tok)
+        for slot in active:
+            self._commit_token(slot, int(next_host[slot]))
+        self._update_active_stats()
+        return True
 
     # --- background loop -----------------------------------------------------
 
@@ -1444,6 +1674,16 @@ class InferenceEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+
+    def is_alive(self) -> bool:
+        """True while the engine can still make progress on submitted
+        requests: not stopped, and — when a background loop was started
+        — its thread is actually running. The API layer polls this so a
+        dead engine surfaces as a 5xx instead of a client blocking
+        forever on a token queue no one will ever fill."""
+        if self._stop.is_set():
+            return False
+        return self._thread is None or self._thread.is_alive()
 
     # --- convenience ---------------------------------------------------------
 

@@ -1,0 +1,206 @@
+"""Fused mixed-batch engine step: prefill chunk + multi-step decode, ONE dispatch.
+
+The r5 long-context bench (`BENCH_SERVE_QWEN3_8B_INT8_LONG_r05.json`)
+fails both SLAs the moment prefill and decode overlap: the engine ran
+the batched prefill chunk and the decode as SEPARATE device dispatches
+(~120 ms each through the remote-TPU tunnel, docs/perf.md Finding 5)
+and hard-disabled multi-step decode whenever a prompt was mid-prefill,
+degrading every active decoder to one token per TWO dispatches. Runtime
+dissections of LLM serving identify exactly this prefill/decode
+interference as the dominant mixed-load latency tax (arXiv:2311.03687),
+and the TPU/GPU serving gap is mostly dispatch/scheduling overhead, not
+FLOPs (arXiv:2605.25645).
+
+This module is the fix: one jitted program that, against the engine
+cache directly and in a single dispatch,
+
+(a) advances every mid-prefill row one chunk — the pinned-index scatter
+    idiom of ``engine._chunk_batch_fn`` (host-tracked ``starts`` pin
+    each row's cache index for the forward; ``starts + lens`` pins it
+    after, so only prefilling rows advance), then
+(b) runs an ``n``-step ``lax.scan`` decode block over ALL rows — ready
+    decoders produce ``n`` real tokens; mid-prefill and idle rows
+    decode garbage that the overwrite-before-attend invariant already
+    covers (every garbage row is rewritten by the chunk that owns its
+    range, or by real decode in order, before any query can attend it).
+
+Correctness bounds the scheduler must respect (enforced by
+``InferenceEngine._mixed_feasible``; violation falls back to the
+sequential two-dispatch path with a logged reason):
+
+- ``n <= chunk``: the scan writes ``n`` garbage rows above each
+  mid-prefill row's watermark; the next chunk's padded write (width
+  ``chunk``) must cover them.
+- prefill rows: ``done + chunk + n <= cache_len`` — both the chunk
+  scatter and the garbage scan rows must land inside the cache (a
+  clamped scatter would shift backward over attended prompt KV).
+- decode rows: ``slot_len + chunk <= cache_len`` — the dead chunk
+  write window must fit (same bound as the batched chunk path); the
+  scan's real writes fit a fortiori since ``n <= chunk``.
+- free rows: dead either way; the caller clamps their pinned index to
+  ``cache_len - chunk`` so even the dead window stays in bounds.
+
+Token-exactness: part (a) is bit-identical to ``_chunk_batch_fn`` (same
+pinning arithmetic) and part (b) to ``_decode_multi_fn`` (same scan
+body, same per-step key split), so greedy outputs equal the sequential
+path's exactly — pinned by ``tests/test_mixed_step.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from llm_in_practise_tpu.infer.sampling import sample_token_batched
+
+
+def pin_index(cache, index_vec):
+    """Replace every layer's ``index`` with the host-provided vector —
+    the shared pin/advance idiom of the batched chunk, draft, and fused
+    mixed-step paths (one place to fix if the cache key convention
+    changes)."""
+    return [
+        {k: (index_vec.astype(jnp.int32) if k == "index" else v)
+         for k, v in layer.items()}
+        for layer in cache
+    ]
+
+
+def decode_scan(model, params, cache, tokens, rng, temperature, top_k,
+                top_p, greedy, *, n):
+    """``n`` single-token decodes under one ``lax.scan`` — the SHARED
+    body of the sequential multi-step program
+    (``engine._decode_multi_fn``) and the fused mixed step, so the two
+    dispatch modes can never drift apart in sampling or key-split
+    order. Returns ``((B, n) tokens, cache)``."""
+
+    def body(carry, key):
+        tok, c = carry
+        lg, c = model.apply(
+            {"params": params}, tok[:, None], deterministic=True,
+            cache=c,
+        )
+        nxt = sample_token_batched(
+            key, lg[:, -1, :].astype(jnp.float32),
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            greedy=greedy,
+        ).astype(jnp.int32)
+        return (nxt, c), nxt
+
+    keys = jax.random.split(rng, n)
+    (_, cache), toks = jax.lax.scan(body, (tokens, cache), keys)
+    return toks.T, cache                                     # (B, n)
+
+
+def batched_chunk(model, params, cache, chunk_ids, starts, lens):
+    """Advance every row one pinned-index prefill chunk against the
+    whole cache — the SHARED body of ``engine._chunk_batch_fn`` and the
+    fused mixed step (see that method's docstring for the invariants).
+    Returns ``((B, vocab) last-real-position logits, cache)`` with the
+    cache index pinned to ``starts + lens``."""
+    logits, cache = model.apply(
+        {"params": params}, chunk_ids, deterministic=True,
+        cache=pin_index(cache, starts)
+    )
+    cache = pin_index(cache, starts + lens)
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(lens - 1, 0)[:, None, None], axis=1
+    )[:, 0, :]
+    return last, cache
+
+
+def make_mixed_step(model):
+    """Build the fused mixed-step function for ``model`` (jit with
+    ``donate_argnums=(1,)`` and ``static_argnames=("n",)``).
+
+    Signature of the returned function::
+
+        chunk_last, toks, cache = fn(
+            params, cache, chunk_ids, starts, lens, advance,
+            tokens, rng, temperature, top_k, top_p, greedy, n=n)
+
+    - ``chunk_ids`` (max_slots, chunk): real chunk tokens for
+      mid-prefill rows, zeros elsewhere.
+    - ``starts``/``lens`` (max_slots,): host-pinned cache index per row
+      and real chunk length (0 for non-prefill rows).
+    - ``advance`` (max_slots,): how far the decode block REALLY moves
+      each row — ``n`` for ready decode rows, 0 elsewhere. The scan
+      bumps every row's device index by ``n``; the final pin
+      ``starts + lens + advance`` undoes that for mid-prefill and idle
+      rows, so a prompt whose last chunk completes inside this dispatch
+      activates at exactly ``plen`` (the next, unpinned decode dispatch
+      must not leave an ``n``-row garbage gap below its write index).
+    - ``tokens`` (max_slots,): last sampled token per ready decode row
+      (garbage elsewhere).
+    - ``chunk_last`` (max_slots, vocab): last-real-position logits of
+      the chunk forward (meaningful only for prefill rows).
+    - ``toks`` (max_slots, n): the decode block's sampled tokens
+      (meaningful only for ready rows).
+
+    Compiled variants: one per distinct ``n`` — the engine quantizes
+    block lengths to powers of two, bounding this at
+    log2(decode_steps)+1, all reachable by warmup.
+    """
+
+    def mixed_step_fn(params, cache, chunk_ids, starts, lens, advance,
+                      tokens, rng, temperature, top_k, top_p, greedy,
+                      *, n):
+        # (a) one prefill chunk for every mid-prefill row, engine cache
+        # directly — the same body _chunk_batch_fn compiles
+        chunk_last, cache = batched_chunk(
+            model, params, cache, chunk_ids, starts, lens)
+        # (b) n-step decode block over all rows — the same body
+        # _decode_multi_fn compiles
+        toks, cache = decode_scan(
+            model, params, cache, tokens, rng, temperature, top_k,
+            top_p, greedy, n=n)
+        # the scan advanced EVERY row's index by n; only ready decode
+        # rows really moved — pin the rest back (see ``advance`` above)
+        cache = pin_index(cache, starts + lens + advance)
+        return chunk_last, toks, cache                       # (B, n)
+
+    return mixed_step_fn
+
+
+def plan_decode_block(*, decode_steps: int, queue_depth: int,
+                      soonest_finish: int | None,
+                      chunk: int | None,
+                      prefill_headroom: int | None) -> int:
+    """Token-budget planner for the decode block length ``n``
+    (Sarathi-style stall-free batching, host side).
+
+    Pure function so the policy is unit-testable without an engine:
+
+    - start from the configured ``decode_steps``;
+    - under queueing (``queue_depth > 0``) cap at the soonest
+      *deterministic* completion among active rows (token budget or
+      cache room), so a freed slot refills at the very next step;
+    - while any row is mid-prefill, cap at ``chunk`` (the scan's
+      garbage rows must be covered by the next chunk's write) and at
+      ``prefill_headroom`` (= min over prefill rows of
+      ``cache_len - chunk - done``: the garbage window must land inside
+      the cache);
+    - a CAPPED length is quantized DOWN to a power of two — every
+      distinct ``n`` is its own compiled program, and an uncapped
+      1..decode_steps range lets a first-seen length land a
+      multi-second compile inside a latency-SLA request (measured r4:
+      a 703 ms-mean-TPOT outlier in an otherwise 70 ms ladder). The
+      configured ``decode_steps`` itself always runs at full value (a
+      non-pow2 ``--decode-steps 6`` means 6, not 4) — it is one known,
+      warmup-reachable variant.
+    """
+    n = decode_steps
+    capped = False
+    if (n > 1 and queue_depth > 0 and soonest_finish is not None
+            and soonest_finish < n):
+        n = max(1, soonest_finish)
+        capped = True
+    if chunk is not None and chunk < n:
+        n = max(1, chunk)
+        capped = True
+    if prefill_headroom is not None and prefill_headroom < n:
+        n = max(1, prefill_headroom)
+        capped = True
+    if capped and n > 1:
+        n = 1 << (n.bit_length() - 1)
+    return n

@@ -60,6 +60,21 @@ def test_idempotent(tmp_path, monkeypatch):
     assert compile_cache.enable_compilation_cache(d) == d
 
 
+def test_respects_user_set_cache_dir(tmp_path, monkeypatch):
+    """A ``jax_compilation_cache_dir`` the user/environment already set
+    (JAX_COMPILATION_CACHE_DIR or a direct jax.config.update) is never
+    clobbered process-wide: the helper reports it and leaves the
+    cache-everything thresholds alone."""
+    theirs = str(tmp_path / "user-dir")
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    jax.config.update("jax_compilation_cache_dir", theirs)
+    before = jax.config.jax_persistent_cache_min_compile_time_secs
+    got = compile_cache.enable_compilation_cache(str(tmp_path / "ours"))
+    assert got == theirs
+    assert jax.config.jax_compilation_cache_dir == theirs
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == before
+
+
 def test_engine_enables_cache(tmp_path, monkeypatch):
     """InferenceEngine construction turns the cache on (restart story)."""
     from llm_in_practise_tpu.models.gpt import GPT, GPTConfig

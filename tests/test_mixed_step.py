@@ -1,0 +1,279 @@
+"""Fused mixed-batch engine step (serve/mixed_step.py).
+
+The r5 long-context bench showed mixed-load steps paying TWO device
+dispatches (chunk + decode) with multi-step decode force-disabled —
+the conc-4 TPOT p99 collapse. The fused step runs the prefill chunk
+and the n-step decode block in ONE dispatch. These tests pin:
+
+- token-exactness: fused vs. sequential (``mixed_step=False``) produce
+  identical greedy tokens AND identical cache contents mid-flight;
+- dispatch accounting: exactly 1 engine-program dispatch per ``step()``
+  under simultaneous prefill+decode (the new ``DispatchMeter``), vs.
+  >= 2 on the sequential path;
+- the decode block keeps n>1 while ``slot_prefill`` is non-empty —
+  the deleted ``use_multi`` gate stays deleted;
+- speculative engines suspend (with a logged reason) rather than
+  silently changing outputs;
+- the ``plan_decode_block`` policy (pow2 quantization, soonest-finish
+  and chunk-window caps).
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
+from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+from llm_in_practise_tpu.serve.mixed_step import plan_decode_block
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    cfg = GPTConfig(vocab_size=64, seq_len=192, n_layer=2, n_head=2,
+                    embed_dim=32, dropout=0.0, pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("cache_len", 192)
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("chunked_prefill", 8)
+    kw.setdefault("decode_steps", 4)
+    return InferenceEngine(model, params, **kw)
+
+
+SHORT = ([3, 1, 4, 1, 5, 9], [2, 7, 1, 8, 2, 8])
+LONG = [(i * 7 + 3) % 64 for i in range(40)]   # 40 tokens -> 5 chunks of 8
+
+
+def _run_mixed_load(eng):
+    """Deterministic mixed load, manually stepped: two short prompts
+    decode while a long prompt chunk-prefills."""
+    sp = SamplingParams(greedy=True, max_tokens=24)
+    h = [eng.submit(p, sp) for p in SHORT]
+    eng.step()                      # admit both, first decode block
+    hl = eng.submit(LONG, SamplingParams(greedy=True, max_tokens=8))
+    while eng.step():
+        pass
+    return [r.result() for r in (*h, hl)]
+
+
+def test_fused_matches_sequential_tokens(model_params):
+    model, params = model_params
+    fused = _engine(model, params)                      # mixed_step default ON
+    seq = _engine(model, params, mixed_step=False)
+    out_f = _run_mixed_load(fused)
+    out_s = _run_mixed_load(seq)
+    assert out_f == out_s
+    assert fused.mixed_blocks > 0                       # fused path really ran
+    assert seq.mixed_blocks == 0
+
+
+def test_fused_matches_sequential_cache_contents(model_params):
+    """Lockstep-step both engines mid-flight and compare every slot's
+    VALID cache rows (up to each row's host-tracked length) — the fused
+    program must write the same KV the sequential dispatches write."""
+    model, params = model_params
+    sp = SamplingParams(greedy=True, max_tokens=30)
+    engines = [_engine(model, params),
+               _engine(model, params, mixed_step=False)]
+    for eng in engines:
+        for p in SHORT:
+            eng.submit(p, sp)
+        eng.step()
+        eng.submit(LONG, SamplingParams(greedy=True, max_tokens=8))
+        for _ in range(3):                    # mid-prefill, mid-decode
+            eng.step()
+    a, b = engines
+    assert a.slot_prefill and b.slot_prefill  # comparison is mid-prefill
+    assert np.array_equal(a.slot_len, b.slot_len)
+    assert np.array_equal(a.slot_last_token, b.slot_last_token)
+    assert {s: st["done"] for s, st in a.slot_prefill.items()} \
+        == {s: st["done"] for s, st in b.slot_prefill.items()}
+    valid = a.slot_len.copy()
+    for s, st in a.slot_prefill.items():
+        valid[s] = st["done"]
+    for la, lb in zip(a.cache, b.cache):
+        for key in la:
+            if key == "index":
+                continue
+            for s in range(a.max_slots):
+                v = int(valid[s])
+                if v == 0:
+                    continue
+                np.testing.assert_allclose(
+                    np.asarray(la[key])[s, :v],
+                    np.asarray(lb[key])[s, :v],
+                    rtol=1e-5, atol=1e-5, err_msg=f"{key} slot {s}")
+
+
+def test_one_dispatch_per_step_under_mixed_load(model_params):
+    """The acceptance bar: 1 long prompt mid-chunked-prefill + 2 active
+    decoders => exactly ONE device dispatch per step(), with the decode
+    block still n>1 while slot_prefill is non-empty."""
+    model, params = model_params
+    eng = _engine(model, params)
+    sp = SamplingParams(greedy=True, max_tokens=64)
+    h = [eng.submit(p, sp) for p in SHORT]
+    eng.step()                                # admission + first block
+    assert all(r.first_token_time is not None for r in h)
+    hl = eng.submit(LONG, SamplingParams(greedy=True, max_tokens=8))
+    steps_mixed = 0
+    while hl.first_token_time is None:
+        gen_before = [r.n_generated for r in h]
+        blocks_before = eng.multi_blocks
+        eng.step()
+        steps_mixed += 1
+        assert steps_mixed < 12, "long prompt never activated"
+        if eng.slot_prefill:                  # still mid-prefill after step
+            # ONE dispatch covered chunk + decode block
+            assert eng.dispatch_meter.last_step == 1
+            # decode kept its multi-step amortization: n>1 block ran and
+            # every active decoder gained decode_steps tokens this step
+            assert eng.multi_blocks == blocks_before + 1
+            assert [r.n_generated for r in h] \
+                == [g + eng.decode_steps for g in gen_before]
+    assert steps_mixed >= 2                   # prefill really interleaved
+    assert eng.mixed_blocks >= steps_mixed - 1
+
+
+def test_sequential_path_pays_two_dispatches(model_params):
+    """The counterfactual the meter exists to show: with the fused step
+    off, a mixed-load step costs >= 2 dispatches."""
+    model, params = model_params
+    eng = _engine(model, params, mixed_step=False)
+    sp = SamplingParams(greedy=True, max_tokens=64)
+    eng.submit(SHORT[0], sp)
+    eng.step()
+    eng.submit(LONG, SamplingParams(greedy=True, max_tokens=8))
+    eng.step()
+    assert eng.slot_prefill                   # mid-prefill
+    assert eng.dispatch_meter.last_step >= 2
+
+
+def test_decode_only_multistep_is_one_dispatch(model_params):
+    """Sanity on the meter itself: a pure-decode multi-step block is one
+    dispatch; the fused path adds prefill without adding a second."""
+    model, params = model_params
+    eng = _engine(model, params)
+    eng.submit(SHORT[0], SamplingParams(greedy=True, max_tokens=64))
+    eng.step()                                # admit (prefill dispatches)
+    eng.step()                                # pure decode block
+    assert eng.dispatch_meter.last_step == 1
+    assert eng.dispatch_meter.total > 1       # admission was counted too
+
+
+def test_speculative_suspends_with_logged_reason(model_params, caplog):
+    """A speculative engine with decode_steps>1 under mixed load must
+    fall back to the fused plain-decode step with an explicit log line —
+    greedy outputs exactly match the non-spec engine's (spec is
+    lossless), never silently changed."""
+    model, params = model_params
+    ref = _engine(model, params, decode_steps=4)
+    out_ref = _run_mixed_load(ref)
+    spec = _engine(model, params, decode_steps=4, speculative_k=3)
+    with caplog.at_level(logging.INFO, logger="serve.engine"):
+        out_spec = _run_mixed_load(spec)
+    assert out_spec == out_ref
+    assert any("speculative decoding suspended" in r.message
+               for r in caplog.records)
+    assert spec.mixed_blocks > 0
+
+
+def test_speculative_composes_at_single_step(model_params):
+    """With decode_steps=1 a verify step yields 1+accepted tokens per
+    dispatch — strictly more than a fused n=1 block — so speculation
+    keeps running while prompts prefill (the r5 composition) and the
+    fused path stays out of the way. Outputs stay exact."""
+    model, params = model_params
+
+    def run(eng):
+        # repetitive load prompt => the ngram drafter has material
+        h = eng.submit([7, 8, 9, 7, 8, 9, 7, 8],
+                       SamplingParams(greedy=True, max_tokens=30))
+        eng.step()
+        hl = eng.submit(LONG, SamplingParams(greedy=True, max_tokens=8))
+        while eng.step():
+            pass
+        return [h.result(), hl.result()]
+
+    ref = _engine(model, params, decode_steps=1)
+    out_ref = run(ref)
+    spec = _engine(model, params, decode_steps=1, speculative_k=3)
+    out_spec = run(spec)
+    assert out_spec == out_ref
+    assert spec.mixed_blocks == 0            # fused path never engaged
+    assert spec.spec_proposed > 0            # spec really ran
+
+
+def test_mixed_step_respects_cache_tail_fallback(model_params):
+    """A decoder butting against the cache end makes the fused dispatch
+    infeasible (its dead chunk-write window would scatter-clamp over
+    attended KV): the engine must fall back to sequential dispatches —
+    logged, token-exact — not corrupt the tail."""
+    model, params = model_params
+    outs = []
+    engines = {}
+    for mixed in (False, True):
+        eng = engines[mixed] = _engine(model, params, cache_len=64,
+                                       mixed_step=mixed)
+        a = eng.submit(SHORT[0], SamplingParams(greedy=True,
+                                                max_tokens=100))
+        guard = 0
+        while a.n_generated < 44:             # ride slot_len toward 64
+            eng.step()
+            guard += 1
+            assert guard < 40
+        b = eng.submit(LONG[:20], SamplingParams(greedy=True,
+                                                 max_tokens=4))
+        while eng.step():
+            pass
+        assert a.finish_reason == "cache"     # really hit the tail
+        outs.append((a.result(), b.result()))
+    assert outs[0] == outs[1]
+    # the fused engine really took the explicit fallback near the tail
+    assert engines[True]._mixed_fallbacks_logged
+
+
+def test_plan_decode_block_policy():
+    # full block when nobody waits and nothing prefills
+    assert plan_decode_block(decode_steps=8, queue_depth=0,
+                             soonest_finish=None, chunk=None,
+                             prefill_headroom=None) == 8
+    # the CONFIGURED length is never quantized — non-pow2 decode_steps
+    # runs at full value when no cap bites (one known compiled variant)
+    assert plan_decode_block(decode_steps=6, queue_depth=0,
+                             soonest_finish=None, chunk=None,
+                             prefill_headroom=None) == 6
+    assert plan_decode_block(decode_steps=6, queue_depth=0,
+                             soonest_finish=None, chunk=16,
+                             prefill_headroom=100) == 6
+    # soonest-completion cap under queueing, pow2-quantized DOWN
+    assert plan_decode_block(decode_steps=8, queue_depth=1,
+                             soonest_finish=5, chunk=None,
+                             prefill_headroom=None) == 4
+    assert plan_decode_block(decode_steps=8, queue_depth=1,
+                             soonest_finish=1, chunk=None,
+                             prefill_headroom=None) == 1
+    # chunk window caps the block while a prompt prefills
+    assert plan_decode_block(decode_steps=16, queue_depth=0,
+                             soonest_finish=None, chunk=8,
+                             prefill_headroom=100) == 8
+    # prefill rows near the cache end shrink the block, floor 1
+    assert plan_decode_block(decode_steps=8, queue_depth=0,
+                             soonest_finish=None, chunk=8,
+                             prefill_headroom=3) == 2
+    assert plan_decode_block(decode_steps=8, queue_depth=0,
+                             soonest_finish=None, chunk=8,
+                             prefill_headroom=-5) == 1
+    # decode_steps=1 never grows
+    assert plan_decode_block(decode_steps=1, queue_depth=3,
+                             soonest_finish=9, chunk=4,
+                             prefill_headroom=9) == 1

@@ -33,6 +33,27 @@ def _quantize_kernels(params, *, group_size=32, min_size=1024):
     return jax.tree_util.tree_map_with_path(q, params)
 
 
+def test_load_packed_rejects_newer_manifest_format(tmp_path, rng):
+    """A manifest ``format`` newer than the reader understands must fail
+    loudly — a future format may key arrays differently, and loading it
+    with today's rules would silently rebuild garbage uint16 weights."""
+    import json
+    import os
+
+    import pytest
+
+    _, params = _tiny_model(rng)
+    quant_io.save_packed(str(tmp_path), _quantize_kernels(params))
+    mpath = os.path.join(str(tmp_path), "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["format"] = quant_io._MAX_MANIFEST_FORMAT + 1
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="newer than this reader"):
+        quant_io.load_packed(str(tmp_path))
+
+
 def test_packed_roundtrip(tmp_path, rng):
     _, params = _tiny_model(rng)
     qtree = _quantize_kernels(params)

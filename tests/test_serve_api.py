@@ -126,6 +126,39 @@ def test_metrics_exposition(server):
     assert "llm_requests_total" in text
     assert "llm_ttft_seconds" in text
     assert 'quantile="0.99"' in text
+    # dispatch accounting (fused mixed-step observability)
+    assert "llm_dispatches_total" in text
+    assert "llm_dispatches_per_step" in text
+    assert "llm_mixed_blocks_total" in text
+
+
+def test_dead_engine_streaming_returns_503():
+    """A dead engine loop must surface as a 5xx on a streaming request,
+    not a client hanging forever with no headers (the first-token wait
+    is bounded with an engine-liveness check between waits)."""
+    import jax
+
+    cfg = GPTConfig(vocab_size=256, seq_len=64, n_layer=1, n_head=2,
+                    embed_dim=32, dropout=0.0, pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    engine = InferenceEngine(model, params, max_slots=1, cache_len=64,
+                             cache_dtype=jnp.float32)
+    srv = OpenAIServer(engine, ByteTokenizer(), model_name="dead-test")
+    port = srv.serve(host="127.0.0.1", port=0, background=True)
+    engine.stop()                       # engine dies; HTTP stays up
+    assert not engine.is_alive()
+    status, body = _post(("127.0.0.1", port), "/v1/chat/completions", {
+        "model": "dead-test",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4,
+        "temperature": 0.0,
+        "stream": True,
+    })
+    assert status == 503
+    assert json.loads(body)["error"]["code"] == "engine_dead"
+    srv.shutdown()
 
 
 def test_webui_page(server):

@@ -72,16 +72,18 @@ def main() -> None:
     params = model.init(jax.random.PRNGKey(0),
                         jnp.ones((1, 8), jnp.int32))["params"]
     decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
+    mixed_step = os.environ.get("SERVE_MIXED_STEP", "1") != "0"
     engine = InferenceEngine(
         model, params, max_slots=MAX_SLOTS, cache_len=1024,
         chunked_prefill=256, speculative_k=None,
-        decode_steps=decode_steps,
+        decode_steps=decode_steps, mixed_step=mixed_step,
     )
     engine.start()
     tok = ByteTokenizer()
     prompt_ids = [tok.encode(p) for p in PROMPTS]
     print(f"device {jax.devices()[0].device_kind} | slots {MAX_SLOTS} | "
-          f"decode_steps {decode_steps}", flush=True)
+          f"decode_steps {decode_steps} | mixed_step {mixed_step}",
+          flush=True)
 
     # warmup: compile prefill buckets (incl. the pow2 batched-admission
     # sizes up to max_slots), decode, and the capped block variants before
@@ -143,6 +145,10 @@ def main() -> None:
         "engine": {"max_slots": MAX_SLOTS, "cache_len": 1024,
                    "chunked_prefill": 256,
                    "decode_steps": decode_steps,
+                   "mixed_step": mixed_step,
+                   "mixed_blocks": engine.mixed_blocks,
+                   "dispatches_per_step":
+                       round(engine.dispatch_meter.mean_per_step, 3),
                    "batched_prefill_admission": True,
                    "block_cap_under_queueing": True},
         "max_tokens": MAX_TOKENS,

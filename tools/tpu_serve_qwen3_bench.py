@@ -219,12 +219,13 @@ def main() -> None:
           f"{_hbm_stats()}", flush=True)
 
     decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
+    mixed_step = os.environ.get("SERVE_MIXED_STEP", "1") != "0"
     engine = InferenceEngine(
         QuantizedModel(Qwen3(serve_cfg)), qparams, max_slots=MAX_SLOTS,
         cache_len=CACHE_LEN, chunked_prefill=CHUNK, speculative_k=None,
         cache_dtype={"bfloat16": jnp.bfloat16,
                      "fp8": jnp.float8_e4m3fn}[KV_DTYPE],
-        decode_steps=decode_steps,
+        decode_steps=decode_steps, mixed_step=mixed_step,
         # admission knobs OFF during warmup: first-run compiles hold the
         # queue for minutes and a 1.5 s timeout would shed every warmup
         # request before it compiled its program; enabled post-warmup
@@ -239,7 +240,8 @@ def main() -> None:
     else:
         prompt_ids = [tok.encode(p) for p in PROMPTS]
     print(f"device {jax.devices()[0].device_kind} | slots {MAX_SLOTS} | "
-          f"decode_steps {decode_steps}", flush=True)
+          f"decode_steps {decode_steps} | mixed_step {mixed_step}",
+          flush=True)
 
     # Warmup compiles every program the timed ladder will hit: the
     # saturating burst covers decode/chunked variants, then one mini-pass
@@ -351,6 +353,10 @@ def main() -> None:
         "warmup_compile_s": round(warmup_s, 1),
         "engine": {"max_slots": MAX_SLOTS, "cache_len": CACHE_LEN,
                    "chunked_prefill": CHUNK, "decode_steps": decode_steps,
+                   "mixed_step": mixed_step,
+                   "mixed_blocks": engine.mixed_blocks,
+                   "dispatches_per_step":
+                       round(engine.dispatch_meter.mean_per_step, 3),
                    "kv_dtype": KV_DTYPE,
                    "admission": {
                        "queue_timeout_s": QUEUE_TIMEOUT_S or None,
