@@ -20,6 +20,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from llm_in_practise_tpu.serve.gateway import (
+    DisaggRouter,
     Gateway,
     PrefixAffinityRouter,
     ResponseCache,
@@ -33,10 +34,13 @@ from llm_in_practise_tpu.serve.moderation import ModerationService, gateway_hook
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--upstream", action="append", default=[],
-                   metavar="GROUP=URL[@WEIGHT][|MODEL]",
+                   metavar="GROUP=URL[@WEIGHT][|MODEL][#ROLE]",
                    help="repeatable: public model group -> backend URL; "
                         "|MODEL sets the upstream's own model name when it "
-                        "differs from the group (default: same as group)")
+                        "differs from the group (default: same as group); "
+                        "#ROLE marks a disaggregated replica "
+                        "(prefill|decode|both, default both — pair with "
+                        "--routing disagg)")
     p.add_argument("--fallback", action="append", default=[],
                    metavar="GROUP=FALLBACK_GROUP")
     p.add_argument("--cache_ttl", type=float, default=300.0)
@@ -53,9 +57,13 @@ def main():
     p.add_argument("--moderation", action="store_true",
                    help="enable the pre-call guard hook")
     p.add_argument("--routing", default="least_pending",
-                   choices=["least_pending", "prefix_aware"],
+                   choices=["least_pending", "prefix_aware", "disagg"],
                    help="prefix_aware pins conversations to one upstream "
-                        "(llm-d load_aware_prefix parity)")
+                        "(llm-d load_aware_prefix parity); disagg splits "
+                        "requests across #prefill and #decode role pools "
+                        "with KV handoff through the shared kv_pool "
+                        "server (llm-d disaggregation parity — replicas "
+                        "need --role + --kv-remote)")
     p.add_argument("--standby", action="append", default=[],
                    metavar="GROUP=URL[|MODEL]",
                    help="repeatable: replicas the autoscaler may bring into "
@@ -72,11 +80,17 @@ def main():
     # default pairs with examples/serve_openai.py's default model_name
     for spec in args.upstream or ["chat=http://127.0.0.1:8000|qwen3-tpu"]:
         group, _, rest = spec.partition("=")
+        rest, _, role = rest.partition("#")
         rest, _, model = rest.partition("|")
         url, _, weight = rest.partition("@")
+        role = (role or "both").strip().lower()
+        if role not in ("prefill", "decode", "both"):
+            p.error(f"invalid role {role!r} in --upstream {spec!r} "
+                    "(want prefill|decode|both)")
         upstreams.append(Upstream(
             url.rstrip("/"), model=model or group, group=group,
             weight=float(weight) if weight else 1.0,
+            role=role,
         ))
     fallbacks: dict[str, list[str]] = {}
     for spec in args.fallback:
@@ -94,9 +108,10 @@ def main():
         thr = args.semantic_threshold if args.semantic_threshold > 0 else None
         cache = ResponseCache(ttl_s=args.cache_ttl, semantic_threshold=thr)
 
-    router_cls = (
-        PrefixAffinityRouter if args.routing == "prefix_aware" else Router
-    )
+    router_cls = {
+        "prefix_aware": PrefixAffinityRouter,
+        "disagg": DisaggRouter,
+    }.get(args.routing, Router)
     gw = Gateway(
         router_cls(upstreams),
         retry_policy=RetryPolicy(),
@@ -142,7 +157,8 @@ def main():
               f"{sum(len(v) for v in standby.values())} standby replicas")
 
     for u in upstreams:
-        print(f"upstream {u.group}: {u.base_url} (weight {u.weight})")
+        tag = "" if u.role == "both" else f", role {u.role}"
+        print(f"upstream {u.group}: {u.base_url} (weight {u.weight}{tag})")
     print(f"gateway on {args.host}:{args.port}")
     try:
         gw.serve(host=args.host, port=args.port)

@@ -62,6 +62,18 @@ def main():
                    help="share prefix KV through a kv_pool server at "
                         "HOST:PORT (LMCache lm:// parity; start one with "
                         "python -m llm_in_practise_tpu.serve.kv_pool)")
+    p.add_argument("--role", default="both",
+                   choices=["prefill", "decode", "both"],
+                   help="disaggregated serving role (llm-d parity): "
+                        "'prefill' replicas only prefill and hand the "
+                        "prompt KV to the pool's handoff namespace; "
+                        "'decode' replicas claim it and run pure decode "
+                        "(zero prefill interference); 'both' (default) "
+                        "is a full replica. prefill/decode require "
+                        "--kv-remote (the handoff travels through the "
+                        "shared pool) and a gateway running the disagg "
+                        "router (examples/serve_gateway.py --routing "
+                        "disagg)")
     p.add_argument("--speculative", dest="speculative", type=int,
                    nargs="?", const=4, default=None, metavar="K",
                    help="ngram/prompt-lookup speculative decoding: draft K "
@@ -128,6 +140,10 @@ def main():
                 "adapters merge by unrolled block_i/... kernel paths, "
                 "which do not exist in the stacked tree (they would "
                 "silently serve base weights)")
+    if args.role != "both" and not args.kv_remote:
+        p.error(f"--role {args.role} requires --kv-remote: the KV handoff "
+                "between the prefill and decode pools travels through the "
+                "shared kv_pool server")
     if args.draft_model_path and args.speculative is None:
         p.error("--draft-model-path requires --speculative K")
     if args.draft_model_path and args.scan_layers:
@@ -221,6 +237,25 @@ def main():
         draft_model = Qwen3(Qwen3Config.from_dict(draft_meta["config"]))
         print(f"draft model: {args.draft_model_path}")
 
+    # disaggregated serving: the handoff store rides the shared pool
+    # server (pin-until-claimed namespace, serve/disagg.py). Any replica
+    # with a pool connection gets one — "both" replicas then still serve
+    # /internal/handoff/prefill and claim entries when a role pool is
+    # degraded. Per MODEL: each served name (base + every adapter) gets
+    # its own namespace, so cross-model handoffs can never collide.
+    def make_handoff(model_name):
+        if not args.kv_remote:
+            return None
+        from llm_in_practise_tpu.serve.disagg import RemoteHandoff
+
+        rhost, rport = args.kv_remote.rsplit(":", 1)
+        return RemoteHandoff((rhost, int(rport)), namespace=model_name)
+
+    handoff = make_handoff(args.model_name)
+    if handoff is not None and args.role != "both":
+        print(f"disaggregated role: {args.role} "
+              f"(handoff via {args.kv_remote})")
+
     engine_kw = dict(
         max_slots=args.max_slots, cache_len=args.cache_len,
         eos_id=tok.token_to_id(IM_END),
@@ -237,6 +272,7 @@ def main():
     )
     engine = InferenceEngine(model, params,
                              kv_pool=make_kv_pool(args.model_name),
+                             role=args.role, handoff=handoff,
                              **engine_kw)
     adapters = {}
     if args.lora_modules:
@@ -252,12 +288,17 @@ def main():
         adapters = build_adapter_engines(
             model, params, parse_lora_modules(args.lora_modules),
             param_transform=shard_fn,
-            engine_kw_for=lambda name: {"kv_pool": make_kv_pool(name)},
+            # per-model tiers AND per-model handoff namespace: adapter
+            # requests disaggregate exactly like the base model's
+            engine_kw_for=lambda name: {"kv_pool": make_kv_pool(name),
+                                        "role": args.role,
+                                        "handoff": make_handoff(name)},
             **adapter_kw
         )
         print(f"adapters: {sorted(adapters)}")
     server = OpenAIServer(engine, tok, model_name=args.model_name,
-                          adapters=adapters)
+                          adapters=adapters, role=args.role,
+                          handoff=handoff)
     print(f"serving on {args.host}:{args.port} "
           f"(/v1/chat/completions, /v1/models, /health, /metrics)")
     server.serve(host=args.host, port=args.port)

@@ -107,6 +107,33 @@ class DispatchMeter:
         return self._mean
 
 
+class HandoffMeter:
+    """Claim-side accounting for disaggregated prefill/decode serving
+    (serve/disagg.py). The publish side lives on the prefill engine
+    (``handoff_published`` / ``handoff_publish_failed``); this meter sits
+    in the decode replica's API layer, where handoff ids arrive and
+    either resolve to a pinned KV entry or turn out lost. ``/metrics``
+    renders these as ``llm_handoff_total{event=...}`` and
+    ``llm_handoff_lost_total`` — the llm-d disaggregation dashboards'
+    first-order health signal (lost handoffs mean the decode pool is
+    paying for prefill again).
+
+    Plain int increments under the GIL — same contract as the engine's
+    own counters (scrapers read a near-current snapshot)."""
+
+    def __init__(self):
+        self.claimed = 0        # handoff ids that resolved to an entry
+        self.lost = 0           # ids that resolved to nothing → re-prefill
+        self.repinned = 0       # entries re-published after a local shed
+        self.repin_failed = 0   # ...and re-pins that could not land
+
+    def claim_outcome(self, entry_found: bool) -> None:
+        if entry_found:
+            self.claimed += 1
+        else:
+            self.lost += 1
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: str | None):
     """``with profile_trace("/tmp/trace"):`` — jax.profiler trace around the

@@ -22,12 +22,18 @@ from llm_in_practise_tpu.serve.adapters import (  # noqa: F401
     parse_lora_modules,
 )
 from llm_in_practise_tpu.serve.gateway import (  # noqa: F401
+    DisaggRouter,
     Gateway,
     PrefixAffinityRouter,
     ResponseCache,
     RetryPolicy,
     Router,
     Upstream,
+)
+from llm_in_practise_tpu.serve.disagg import (  # noqa: F401
+    LocalHandoff,
+    RemoteHandoff,
+    new_handoff_id,
 )
 from llm_in_practise_tpu.serve.prefix_cache import PrefixCache  # noqa: F401
 from llm_in_practise_tpu.serve.kv_pool import (  # noqa: F401

@@ -61,11 +61,27 @@ class OpenAIServer:
         model_name: str = "llm-in-practise-tpu",
         prompt_builder=build_prompt,
         adapters: dict[str, InferenceEngine] | None = None,
+        role: str = "both",
+        handoff=None,
     ):
+        from llm_in_practise_tpu.obs.meter import HandoffMeter
+        from llm_in_practise_tpu.serve.disagg import validate_roles
+
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.prompt_builder = prompt_builder
+        # Disaggregated serving (serve/disagg.py): ``role`` gates the
+        # internal handoff endpoint and labels the per-role latency
+        # metrics; ``handoff`` is the store prefill publishes into and
+        # decode claims from (shared pool server, or LocalHandoff for
+        # single-process setups).
+        self.role = validate_roles(role)
+        # decode claims from the same store the engine publishes into
+        # unless the caller splits them explicitly
+        self.handoff = (handoff if handoff is not None
+                        else getattr(engine, "handoff", None))
+        self.handoff_meter = HandoffMeter()
         # vLLM ``--enable-lora --lora-modules name=path`` parity: additional
         # model names served from adapter-merged weights, picked by the
         # request's ``model`` field (see serve/adapters.py).
@@ -153,6 +169,67 @@ class OpenAIServer:
             "usage": {"prompt_tokens": total, "total_tokens": total},
         })
 
+    def handle_prefill(self, body: dict, send_json):
+        """``POST /internal/handoff/prefill`` — the prefill half of
+        disaggregated serving (serve/disagg.py). Runs prefill only,
+        publishes the prompt KV into the handoff store, and returns the
+        handoff id the router passes to a decode replica via
+        ``kv_transfer_params``. Internal: only the gateway calls this
+        (it is absent on pure-decode replicas)."""
+        from llm_in_practise_tpu.serve.disagg import new_handoff_id
+
+        if self.role == "decode":
+            return send_json(501, {"error": {
+                "message": "decode replicas do not prefill for handoff",
+                "type": "unsupported_error"}})
+        try:
+            req = schemas.ChatCompletionRequest.from_dict(
+                dict(body, model=body.get("model") or self.model_name))
+        except schemas.ValidationError as e:
+            return send_json(422, {"error": {
+                "message": str(e), "type": "invalid_request_error"}})
+        engine = self.engine_for(req.model)
+        if engine is None:
+            return send_json(404, {"error": {
+                "message": f"model {req.model!r} not found",
+                "type": "invalid_request_error"}})
+        if getattr(engine, "handoff", None) is None:
+            # per-MODEL capability: an adapter engine without its own
+            # handoff store must 501 here, not burn a prefill whose
+            # publish is guaranteed to fail (the gateway treats 501 as
+            # "serve undisaggregated", not as an upstream failure)
+            return send_json(501, {"error": {
+                "message": f"model {req.model!r} has no handoff store "
+                           "on this replica",
+                "type": "unsupported_error"}})
+        prompt_ids = self.tokenizer.encode(self.prompt_builder(req.messages))
+        hid = new_handoff_id()
+        handle = engine.submit(prompt_ids, SamplingParams(max_tokens=1),
+                               handoff_id=hid)
+        from llm_in_practise_tpu.serve.engine import EngineDeadError
+
+        try:
+            handle.result()    # drains to _FINISH; prefill emits no tokens
+        except EngineDeadError:
+            return send_json(503, {"error": {
+                "message": "engine is not running", "type": "internal_error",
+                "code": "engine_dead"}})
+        if handle.finish_reason == "queue_full":
+            return send_json(429, {"error": {
+                "message": "prefill queue full — retry another replica",
+                "type": "rate_limit_error", "code": "queue_full"}})
+        if handle.finish_reason != "handoff":
+            return send_json(503, {"error": {
+                "message": "KV publish failed (pool unreachable or "
+                           "handoff budget exhausted) — serve this "
+                           "request undisaggregated",
+                "type": "internal_error", "code": "handoff_failed"}})
+        return send_json(200, {
+            "handoff_id": hid,
+            "prompt_tokens": len(handle.prompt_ids),
+            "model": req.model,
+        })
+
     def handle_chat(self, body: dict, send_json, send_stream):
         try:
             req = schemas.ChatCompletionRequest.from_dict(body)
@@ -175,12 +252,46 @@ class OpenAIServer:
             greedy=req.temperature == 0.0,
             max_tokens=req.max_tokens,
         )
-        handle = engine.submit(prompt_ids, params)
+        # disaggregated serving: a router that already prefilled this
+        # prompt elsewhere points us at the pinned KV entry; a lost claim
+        # (expired/claimed/unreachable) degrades to local prefill — the
+        # engine counts it, the stream is correct either way
+        kv_entry = None
+        xfer = body.get("kv_transfer_params")
+        if isinstance(xfer, dict) and xfer.get("handoff_id"):
+            # claim from the target MODEL's store when it has one (each
+            # model's handoff namespace is distinct — base vs adapters),
+            # else the server-level store
+            store = getattr(engine, "handoff", None) or self.handoff
+            if store is not None:
+                kv_entry = store.claim(str(xfer["handoff_id"]))
+            self.handoff_meter.claim_outcome(kv_entry is not None)
+        handle = engine.submit(prompt_ids, params, kv_entry=kv_entry)
         req_id = schemas.completion_id()
 
         def queue_full_429(message):
-            # one shape for every shed path: the gateway's retry policy
-            # keys on the status + code
+            # one shape for every shed path (max_queue at submit AND the
+            # later queue_timeout sheds): the gateway's retry policy
+            # keys on the status + code. A shed request never used its
+            # claimed (claim-once) handoff entry, so re-pin it first —
+            # the gateway's retry against another decode upstream then
+            # claims it instead of paying prefill again, exactly when
+            # the pool is saturated.
+            if kv_entry is not None:
+                try:
+                    store.publish(str(xfer["handoff_id"]), kv_entry)
+                except Exception as e:  # noqa: BLE001 — the retry will
+                    # degrade to a local prefill; leave a trace of where
+                    # the entry went (silent loss is undebuggable)
+                    self.handoff_meter.repin_failed += 1
+                    from llm_in_practise_tpu.obs.logging import get_logger
+
+                    get_logger("serve.api").warning(
+                        "could not re-pin shed handoff entry %s (%s: "
+                        "%s); the retry will re-prefill",
+                        xfer["handoff_id"], type(e).__name__, e)
+                else:
+                    self.handoff_meter.repinned += 1
             return send_json(429, {"error": {
                 "message": message + " — retry later or against "
                            "another replica",
@@ -291,13 +402,51 @@ class OpenAIServer:
             "# TYPE llm_mixed_blocks_total counter",
             f"llm_mixed_blocks_total {self.engine.mixed_blocks}",
         ]
+        # per-role latency labels (disaggregated serving): a prefill
+        # replica's "TTFT" is KV-ready time, a decode replica's TPOT is
+        # the interference-free number the split exists for. Plain
+        # (unlabeled) series are kept for role=both so existing
+        # dashboards/scrapes see the same names.
+        role_label = "" if self.role == "both" else f'role="{self.role}",'
+        # _count/_sum must carry the SAME parent label set as the
+        # quantile series (Prometheus summary convention) or per-role
+        # rate()/avg queries silently return nothing
+        bare_label = "" if self.role == "both" else f'{{role="{self.role}"}}'
         for name, vals in (("llm_ttft_seconds", ttft), ("llm_tpot_seconds", tpot)):
             lines += [
                 f"# TYPE {name} summary",
-                f'{name}{{quantile="0.5"}} {_quantile(vals, 0.5):.6f}',
-                f'{name}{{quantile="0.99"}} {_quantile(vals, 0.99):.6f}',
-                f"{name}_count {len(vals)}",
-                f"{name}_sum {sum(vals):.6f}",
+                f'{name}{{{role_label}quantile="0.5"}} '
+                f"{_quantile(vals, 0.5):.6f}",
+                f'{name}{{{role_label}quantile="0.99"}} '
+                f"{_quantile(vals, 0.99):.6f}",
+                f"{name}_count{bare_label} {len(vals)}",
+                f"{name}_sum{bare_label} {sum(vals):.6f}",
+            ]
+        # disaggregation accounting: published/claimed say the handoff
+        # plane works; lost + local re-prefills say how often the decode
+        # pool fell back to doing prefill itself (the llm-d health signal)
+        eng = self.engine
+        hm = self.handoff_meter
+        if (self.role != "both" or eng.handoff is not None
+                or hm.claimed or hm.lost):
+            lines += [
+                "# TYPE llm_handoff_total counter",
+                f'llm_handoff_total{{event="published"}} '
+                f"{eng.handoff_published}",
+                f'llm_handoff_total{{event="publish_failed"}} '
+                f"{eng.handoff_publish_failed}",
+                f'llm_handoff_total{{event="claimed"}} {hm.claimed}',
+                f'llm_handoff_total{{event="kv_admitted"}} '
+                f"{eng.kv_admitted}",
+                f'llm_handoff_total{{event="kv_rejected"}} '
+                f"{eng.kv_rejected}",
+                f'llm_handoff_total{{event="repinned"}} {hm.repinned}',
+                f'llm_handoff_total{{event="repin_failed"}} '
+                f"{hm.repin_failed}",
+                "# TYPE llm_handoff_lost_total counter",
+                f"llm_handoff_lost_total {hm.lost}",
+                "# TYPE llm_local_prefills_total counter",
+                f"llm_local_prefills_total {eng.local_prefills}",
             ]
         pc = self.engine.prefix_cache
         if pc is not None:
@@ -382,7 +531,8 @@ class OpenAIServer:
 
             def do_POST(self):
                 if self.path not in ("/v1/chat/completions",
-                                     "/v1/embeddings"):
+                                     "/v1/embeddings",
+                                     "/internal/handoff/prefill"):
                     return self._json(404, {"error": {"message": "not found"}})
                 body, err = self._read_json()
                 if err:
@@ -390,6 +540,8 @@ class OpenAIServer:
                 try:
                     if self.path == "/v1/embeddings":
                         return server.handle_embeddings(body, self._json)
+                    if self.path == "/internal/handoff/prefill":
+                        return server.handle_prefill(body, self._json)
                     return server.handle_chat(body, self._json, self._sse)
                 except Exception as e:  # noqa: BLE001 — a handler fault must
                     # still answer the client, not drop the connection. If a

@@ -76,9 +76,20 @@ class ReplicaAutoscaler:
 
     def __init__(self, router: Router, group: str, *,
                  spawn, stop, config: AutoscaleConfig | None = None,
-                 clock=time.time):
+                 clock=time.time, role: str | None = None):
         self.router = router
         self.group = group
+        # Disaggregated serving: a role-scoped scaler controls ONE pool
+        # of a group (role="prefill" or "decode"); its load signal is
+        # the in-flight count on that pool's upstreams, which measures
+        # exactly what that pool is short of — pending prefill handoffs
+        # ARE the prefill queue depth (the gateway holds pending for the
+        # whole /internal/handoff/prefill call), and pending decode
+        # streams ARE slot occupancy (the stream handle holds pending
+        # until the stream closes — see gateway._StreamHandle). None =
+        # scale the whole group (pre-disagg behavior). role="both"
+        # replicas belong to neither role pool and are left alone.
+        self.role = role
         self.spawn = spawn
         self.stop = stop
         self.config = config or AutoscaleConfig()
@@ -103,7 +114,10 @@ class ReplicaAutoscaler:
     # -- observability --------------------------------------------------------
 
     def replicas(self) -> list[Upstream]:
-        return [u for u in self.router.upstreams if u.group == self.group]
+        return [u for u in self.router.upstreams
+                if u.group == self.group
+                and (self.role is None
+                     or getattr(u, "role", "both") == self.role)]
 
     def ongoing(self) -> int:
         # draining victims left the router but their in-flight requests are
@@ -190,7 +204,20 @@ class ReplicaAutoscaler:
         if n_spawn:
             try:
                 for _ in range(n_spawn):
-                    fresh.append(self.spawn())
+                    u = self.spawn()
+                    if (self.role is not None
+                            and getattr(u, "role", "both") != self.role):
+                        # a wrong-role replica would join the router but
+                        # never this scaler's replicas() count — desired
+                        # stays > current and the controller spawns
+                        # forever. Fail loudly instead (start()'s loop
+                        # logs + counts it).
+                        self.stop(u)
+                        raise ValueError(
+                            f"spawn for the {self.role!r} pool returned "
+                            f"an upstream with role "
+                            f"{getattr(u, 'role', 'both')!r}")
+                    fresh.append(u)
             finally:
                 # register even a partial batch (a failed later spawn must
                 # not leak the replicas already brought up); atomic list
@@ -224,3 +251,47 @@ class ReplicaAutoscaler:
         self._stop_event.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+def make_disagg_autoscalers(
+    router: Router, group: str, *,
+    spawn_prefill, stop_prefill, spawn_decode, stop_decode,
+    prefill_config: AutoscaleConfig | None = None,
+    decode_config: AutoscaleConfig | None = None,
+    clock=time.time,
+) -> tuple[ReplicaAutoscaler, ReplicaAutoscaler]:
+    """Per-role controllers for a disaggregated group (serve/disagg.py).
+
+    The two pools starve on DIFFERENT signals, which is the whole point
+    of splitting them:
+
+    - the **prefill pool** scales on prefill queue pressure — each
+      in-flight ``/internal/handoff/prefill`` call holds ``pending`` on
+      its upstream for the prefill's full duration, so the pool's
+      pending sum is the number of prompts currently waiting on (or
+      occupying) prefill compute;
+    - the **decode pool** scales on slot occupancy — a decode upstream's
+      ``pending`` counts open completion streams (the gateway's stream
+      handle releases it only at stream close), i.e. occupied decode
+      slots, not request arrivals.
+
+    ``spawn_prefill``/``spawn_decode`` must return :class:`Upstream`\\ s
+    with the matching ``role`` — a spawned replica with the wrong role
+    joins neither pool's count and would be re-spawned forever. Defaults
+    differ: prefill work is bursty and short, so its controller reacts
+    faster and targets fewer ongoing requests per replica than the
+    decode controller, whose streams are long-lived.
+    """
+    prefill_config = prefill_config or AutoscaleConfig(
+        target_ongoing_requests=2.0, upscale_delay_s=10.0,
+        downscale_delay_s=300.0)
+    decode_config = decode_config or AutoscaleConfig(
+        target_ongoing_requests=6.0, upscale_delay_s=30.0,
+        downscale_delay_s=600.0)
+    pre = ReplicaAutoscaler(router, group, role="prefill",
+                            spawn=spawn_prefill, stop=stop_prefill,
+                            config=prefill_config, clock=clock)
+    dec = ReplicaAutoscaler(router, group, role="decode",
+                            spawn=spawn_decode, stop=stop_decode,
+                            config=decode_config, clock=clock)
+    return pre, dec

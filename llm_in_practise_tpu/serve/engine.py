@@ -90,6 +90,15 @@ class Request:
     # liveness check instead of blocking forever on a dead engine
     engine: "InferenceEngine | None" = dataclasses.field(
         default=None, repr=False, compare=False)
+    # Disaggregated serving (serve/disagg.py): ``kv_entry`` is a device
+    # PrefixEntry claimed from a handoff store — admission seeds the slot
+    # via the full-prefix direct-insert path, zero prefill work here.
+    # ``handoff_id`` marks a prefill-role request: the engine publishes
+    # the prompt KV under this id when prefill completes and finishes the
+    # request (finish_reason "handoff") instead of decoding.
+    kv_entry: object | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    handoff_id: str | None = None
 
     def next_item(self, poll_s: float = 1.0):
         """Next queue item — a token id or the internal finish sentinel
@@ -194,6 +203,8 @@ class InferenceEngine:
         queue_timeout_s: float | None = None,
         draft_model=None,
         draft_params=None,
+        role: str = "both",
+        handoff=None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -409,6 +420,43 @@ class InferenceEngine:
                 f"prefill_budget must be >= 1, got {prefill_budget}"
             )
         self.prefill_budget = prefill_budget
+
+        # Disaggregated serving (serve/disagg.py — the llm-d prefill/
+        # decode split). ``role`` is a *soft* constraint the metrics make
+        # assertable, not a hard gate: a decode replica whose handoff
+        # entry was lost re-prefills locally (graceful degradation, the
+        # llm-d fallback), and the ``local_prefills`` counter + a
+        # logged-once warning surface that it happened. A prefill
+        # replica needs a ``handoff`` store to publish into; requests
+        # carrying a ``handoff_id`` finish at the end of prefill with
+        # ``finish_reason="handoff"`` instead of occupying a decode slot.
+        from llm_in_practise_tpu.serve.disagg import validate_roles
+
+        self.role = validate_roles(role)
+        self.handoff = handoff
+        if role == "prefill" and handoff is None:
+            raise ValueError(
+                "role='prefill' needs a handoff store to publish KV into "
+                "(serve.disagg.LocalHandoff or RemoteHandoff)")
+        self.handoff_published = 0      # entries pinned into the store
+        self.handoff_publish_failed = 0
+        # publisher workers: the device→host copy + TCP put of each
+        # handoff run OFF the engine thread (a dead pool server must
+        # stall only the waiting handoff request, not the decode loop).
+        # A small POOL, not one thread: publishes are independent I/O,
+        # and serializing them would stack each one's transfer — or,
+        # pool-down, its full connect timeout — onto every later
+        # request's KV-ready time. Unbounded queue is safe: in-flight
+        # handoffs are bounded by the router, which waits on each
+        # publish before dispatching the decode half.
+        self._publish_queue: "queue.Queue" = queue.Queue()
+        self._publishers: list[threading.Thread] = []
+        self._n_publishers = min(4, max_slots)
+        self._publish_lock = threading.Lock()  # counter increments
+        self.kv_admitted = 0            # requests seeded by external KV
+        self.kv_rejected = 0            # external entries that failed checks
+        self.local_prefills = 0         # prefills a decode replica ran
+        self._decode_prefill_logged = False
 
         # Dispatch accounting: every jitted engine program is wrapped so
         # /metrics (llm_dispatches_*) and the mixed-step tests can assert
@@ -851,13 +899,24 @@ class InferenceEngine:
             self.stats.requests_shed += 1
         return req
 
-    def submit(self, prompt_ids, params: SamplingParams | None = None) -> Request:
+    def submit(self, prompt_ids, params: SamplingParams | None = None, *,
+               kv_entry=None, handoff_id: str | None = None) -> Request:
+        """``kv_entry`` (optional): a :class:`~.kv_pool.HostEntry` claimed
+        from a handoff store — validated and uploaded HERE, on the
+        caller's (HTTP) thread, so the engine loop admits it as a pure
+        direct insert. ``handoff_id`` (optional): prefill-only request —
+        publish the prompt KV under this id instead of decoding."""
         params = params or SamplingParams()
         prompt_ids = list(map(int, prompt_ids))
         max_prompt = self.cache_len - 2
         if len(prompt_ids) > max_prompt:  # sliding-window crop (reference
             prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
-        req = Request(next(self._uid), prompt_ids, params, engine=self)
+        req = Request(next(self._uid), prompt_ids, params, engine=self,
+                      handoff_id=handoff_id)
+        # the upload must land on the request BEFORE it is queued — the
+        # engine thread may admit it the instant the put releases
+        if kv_entry is not None:
+            req.kv_entry = self._accept_external_kv(kv_entry, prompt_ids)
         with self.stats.lock:
             self.stats.requests_total += 1
         with self._submit_lock:
@@ -868,6 +927,8 @@ class InferenceEngine:
                 shed = False
                 self.pending.put(req)
         if shed:
+            # the caller (api layer) re-pins a claimed handoff entry on
+            # this path so the retry elsewhere can still use it
             return self._shed(req)
         with self.stats.lock:
             self.stats.queue_depth = self.pending.qsize()
@@ -929,6 +990,22 @@ class InferenceEngine:
                 break
             plen = len(req.prompt_ids)
             hit = self._lookup_prefix(req, plen)
+            if (self.role == "decode"
+                    and (hit is None or hit.length < plen)):
+                # graceful degradation, but visible: actual prefill
+                # work on a decode replica is exactly the interference
+                # disaggregation removes. Counted HERE — where the
+                # prefill is really about to run — so neither sheds nor
+                # full prefix/handoff hits inflate the signal.
+                self.local_prefills += 1
+                if not self._decode_prefill_logged:
+                    self._decode_prefill_logged = True
+                    self._log.warning(
+                        "decode-role engine is prefilling locally "
+                        "(handoff entry lost or request arrived without "
+                        "one); serving continues but this replica is no "
+                        "longer interference-free — see "
+                        "llm_local_prefills_total")
             if hit is None and not self._should_chunk(0, plen):
                 self.slot_req[slot] = req   # reserve; activated post-batch
                 self.slot_ready[slot] = False
@@ -998,16 +1075,100 @@ class InferenceEngine:
                 ))
                 for j, (slot, req, plen) in enumerate(part):
                     sl = (slice(None),) * self._sax + (slice(j, j + 1),)
-                    self._store_prefix(
-                        req, plen,
-                        [{k: v[sl] for k, v in layer.items()
-                          if k != "index"} for layer in pre],
-                        last[j:j + 1])
-                    self._activate_with_token(slot, req, plen, int(first[j]))
+                    row_slices = [{k: v[sl] for k, v in layer.items()
+                                   if k != "index"} for layer in pre]
+                    self._store_prefix(req, plen, row_slices,
+                                       last[j:j + 1])
+                    if req.handoff_id is not None:
+                        # the group's bucket IS _bucket_for(plen), so
+                        # these rows are already handoff-width — skip
+                        # the redundant _slot_rows gather
+                        self._complete_handoff(slot, req, plen,
+                                               last[j:j + 1],
+                                               rows=row_slices)
+                    else:
+                        self._activate_with_token(slot, req, plen,
+                                                  int(first[j]))
 
-    def _activate(self, slot: int, req: Request, plen: int, last_logits):
+    def _complete_handoff(self, slot: int, req: Request, plen: int,
+                          last_logits, rows=None) -> None:
+        """Prefill-role completion: the prompt's KV rows are in ``slot``
+        — queue them (plus the last-position logits the decode replica
+        samples the first token from) for publication under the
+        request's handoff id, finish the request WITHOUT decoding, and
+        free the slot. The engine thread pays only the row gather (one
+        dispatch, skipped when the batch/chunked paths already hold the
+        rows); the device→host copy and the TCP put run on a dedicated
+        publisher thread — a slow or dead pool server must stall the
+        WAITING handoff request (whose consumer blocks on ``_FINISH``
+        until the publish lands), never the engine loop that other
+        requests' decode blocks run on. ``rows``: bucket-width
+        index-free row dicts already sliced from the prefill cache."""
+        from llm_in_practise_tpu.serve import prefix_cache as pc
+
+        bucket = self._bucket_for(plen)
+        if rows is None:
+            rows = self._slot_rows(self.cache, jnp.asarray(slot, jnp.int32),
+                                   bucket=bucket)
+        # _slot_rows / the batch slices COPY the rows into fresh buffers,
+        # so the entry is independent of the slot, which frees right here
+        entry = pc.PrefixEntry(length=plen, bucket=bucket, rows=rows,
+                               last_logits=last_logits,
+                               slot_axis=self._sax)
+        self.slot_req[slot] = None
+        self.slot_ready[slot] = False
+        self.slot_budget[slot] = 0
+        self.slot_hist[slot] = None
+        if not self._publishers:
+            self._publishers = [
+                threading.Thread(target=self._run_publisher, daemon=True)
+                for _ in range(self._n_publishers)]
+            for t in self._publishers:
+                t.start()
+        self._publish_queue.put((req, plen, entry))
+
+    def _run_publisher(self) -> None:
+        """Handoff publisher loop: device→host copy + store put, off the
+        engine thread. Finishes each request only once its entry is
+        pinned (or the publish definitively failed), so the router's
+        wait on the prefill response still means 'the KV is claimable'.
+        Several of these run concurrently — see ``_n_publishers``."""
+        from llm_in_practise_tpu.serve.kv_pool import entry_to_host
+
+        while True:
+            req, plen, entry = self._publish_queue.get()
+            try:
+                if self.handoff is None:
+                    raise RuntimeError("engine has no handoff store")
+                self.handoff.publish(req.handoff_id, entry_to_host(entry))
+            except Exception as e:  # noqa: BLE001 — transport/pool
+                # refusal: the request must still finish (the caller
+                # re-prefills at a serving replica)
+                with self._publish_lock:
+                    self.handoff_publish_failed += 1
+                self._log.warning("handoff publish %s failed: %s: %s",
+                                  req.handoff_id, type(e).__name__, e)
+                req.finish_reason = "handoff_failed"
+            else:
+                with self._publish_lock:
+                    self.handoff_published += 1
+                req.finish_reason = "handoff"
+            req.finish_time = time.monotonic()
+            # KV-claimable time is this request's TTFT analog: per-role
+            # llm_ttft_seconds on a prefill replica = prefill service
+            req.first_token_time = req.finish_time
+            req.tokens.put(_FINISH)
+            self.stats.observe_finished(req)
+
+    def _activate(self, slot: int, req: Request, plen: int, last_logits,
+                  rows=None):
         """Slot bookkeeping once the prompt's KV is in place; samples the
-        first token from the prefill logits."""
+        first token from the prefill logits. ``rows`` forwards
+        already-gathered KV rows to the handoff path (chunked prefill
+        gathers them for the prefix store anyway)."""
+        if req.handoff_id is not None:
+            return self._complete_handoff(slot, req, plen, last_logits,
+                                          rows=rows)
         self.rng, sub = jax.random.split(self.rng)
         first = sample_token_batched(
             sub, last_logits.astype(jnp.float32),
@@ -1053,7 +1214,32 @@ class InferenceEngine:
             rem > self.chunked_prefill or not self._oneshot_fits(done, rem)
         )
 
+    def _accept_external_kv(self, host, prompt_ids):
+        """Validate a claimed handoff :class:`~.kv_pool.HostEntry` and
+        upload it as a device PrefixEntry (on the caller's thread), or
+        ``None`` (counted) when it cannot seed a slot here — wrong cache
+        layout/length means replica config drift, and a rejected entry
+        degrades to local prefill rather than corrupting the slot."""
+        from llm_in_practise_tpu.serve.disagg import usable_for_engine
+        from llm_in_practise_tpu.serve.kv_pool import entry_to_device
+
+        why = usable_for_engine(host, prompt_ids, self)
+        if why is not None:
+            self.kv_rejected += 1
+            self._log.warning("rejecting handed-off KV entry: %s", why)
+            return None
+        return entry_to_device(host)
+
     def _lookup_prefix(self, req: Request, plen: int):
+        ext = req.kv_entry
+        if ext is not None:
+            # handed-off KV (disaggregated serving): already validated
+            # full-length at submit — admission is a pure direct insert,
+            # no prefill dispatch, no mid-prefill rows on this replica
+            req.kv_entry = None
+            self.kv_admitted += 1
+            return ext
+
         def usable(entry) -> bool:
             # rows from another engine (shared pool / restart) may be in
             # the other cache layout — their shapes are transposed
@@ -1218,6 +1404,7 @@ class InferenceEngine:
             del self.slot_prefill[slot]
             # rows are already in the slot; store the prefix entry
             # from them (the index is plen — set by the final chunk)
+            rows = None
             if self.prefix_cache is not None:
                 rows = self._slot_rows(
                     self.cache, jnp.asarray(slot, jnp.int32),
@@ -1225,7 +1412,9 @@ class InferenceEngine:
                 self._store_prefix(req, plen, rows,
                                    st["last_logits"],
                                    rows_ready=True)
-            self._activate(slot, req, plen, st["last_logits"])
+            # the gathered rows ride through to the handoff path so a
+            # chunked handoff doesn't pay the gather dispatch twice
+            self._activate(slot, req, plen, st["last_logits"], rows=rows)
 
     def _store_prefix(self, req: Request, plen: int, pre_cache,
                       last_logits, *, rows_ready: bool = False) -> None:

@@ -49,11 +49,22 @@ class Upstream:
     weight: float = 1.0            # cost-based routing weight (higher = prefer)
     allowed_fails: int = 3         # consecutive fails before cooldown
     cooldown_time: float = 30.0    # seconds out of rotation
+    # disaggregated serving (serve/disagg.py): which pool this replica
+    # belongs to. "both" replicas serve either pool — they are the
+    # graceful-degradation capacity when a role pool is empty.
+    role: str = "both"
 
     fails: int = 0
     cooldown_until: float = 0.0
     pending: int = 0
     served: int = 0
+    # per-upstream routing counters, exported at /metrics: picks says
+    # where the router actually sends traffic (vs. served, which also
+    # counts retries), cooldowns says how often this replica tripped the
+    # breaker, affinity_hits says how much of its traffic was cache-warm
+    picks: int = 0
+    cooldowns: int = 0
+    affinity_hits: int = 0
     lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def available(self, now: float) -> bool:
@@ -69,6 +80,7 @@ class Upstream:
             if self.fails >= self.allowed_fails:
                 self.cooldown_until = now + self.cooldown_time
                 self.fails = 0
+                self.cooldowns += 1
 
 
 class _StreamHandle:
@@ -118,16 +130,24 @@ class Router:
         return [u for u in self.upstreams
                 if u.group == group and u.available(now)]
 
+    @staticmethod
+    def _least_pending(cands: list[Upstream]) -> Upstream:
+        """Weighted least-pending selection + pick accounting — the one
+        load metric every routing strategy (base, disagg pools) ranks
+        by; ties broken by total served so sequential traffic
+        round-robins instead of pinning the first entry."""
+        chosen = min(cands, key=lambda u: (
+            (u.pending + 1) / max(u.weight, 1e-9),
+            u.served / max(u.weight, 1e-9),
+        ))
+        chosen.picks += 1
+        return chosen
+
     def pick(self, group: str, exclude: set[int] = frozenset()) -> Upstream:
         cands = [u for u in self.candidates(group) if id(u) not in exclude]
         if not cands:
             raise RouterError(f"no available upstream for {group!r}")
-        # least in-flight per unit weight; ties broken by total served so
-        # sequential traffic round-robins instead of pinning the first entry
-        return min(cands, key=lambda u: (
-            (u.pending + 1) / max(u.weight, 1e-9),
-            u.served / max(u.weight, 1e-9),
-        ))
+        return self._least_pending(cands)
 
     def pick_for_request(self, group: str, body: dict,
                          exclude: set[int] = frozenset()) -> Upstream:
@@ -194,6 +214,9 @@ class PrefixAffinityRouter(Router):
             return (load + miss, u.served / max(u.weight, 1e-9))
 
         chosen = min(cands, key=score)
+        chosen.picks += 1
+        if id(chosen) == sticky_id:
+            chosen.affinity_hits += 1
         if key is not None:
             with self._lock:
                 self._affinity[key] = (now, id(chosen))
@@ -201,6 +224,97 @@ class PrefixAffinityRouter(Router):
                 if len(self._affinity) > self.max_sessions:
                     self._affinity.popitem(last=False)
         return chosen
+
+
+class DisaggRouter(Router):
+    """Disaggregated prefill/decode routing — the llm-d role-split
+    strategy, sibling of :class:`PrefixAffinityRouter`'s
+    ``load_aware_prefix`` (``08-LLM-Router/llm-d``; see serve/disagg.py
+    for the replica side).
+
+    New requests are prefilled by the **prefill pool** (via the
+    gateway's two-phase dispatch, :meth:`Gateway._disagg_prefill`), then
+    the stream is handed to a **decode pool** upstream chosen by
+    least-pending. Degradation is built in: when either role pool is
+    empty (scale-to-zero, rollout, cooldowns) the router behaves like a
+    plain least-pending :class:`Router` over whatever is available —
+    ``role="both"`` upstreams are full replicas and absorb either kind
+    of work — and the decode replica itself re-prefills when a handoff
+    entry is lost, so no pool topology can make a request unservable."""
+
+    def __init__(self, upstreams: list[Upstream]):
+        from llm_in_practise_tpu.serve.disagg import validate_roles
+
+        # fail loudly on a typo'd role ("Prefill", "prefil", ...): the
+        # pools match exact strings, and a misspelled upstream would
+        # silently join NO pool — the whole fleet degrading to plain
+        # routing with only a counter as the clue
+        for u in upstreams:
+            validate_roles(u.role)
+        super().__init__(upstreams)
+        self.degraded_picks = 0   # picks served outside the role split
+
+    def _role_pool(self, group: str, role: str) -> list[Upstream]:
+        return [u for u in self.candidates(group) if u.role == role]
+
+    def disaggregated(self, group: str) -> bool:
+        """Both role pools non-empty = the split is operable. "both"
+        upstreams back-fill EITHER side, but at least one dedicated
+        replica of one role must exist or the two-phase dispatch is
+        pure overhead (prefill + decode on the same pool)."""
+        pre = self._role_pool(group, "prefill")
+        dec = self._role_pool(group, "decode")
+        both = self._role_pool(group, "both")
+        return bool((pre or dec) and (pre or both) and (dec or both))
+
+    def pick_prefill(self, group: str) -> Upstream | None:
+        """Least-pending upstream of the prefill pool, or ``None`` when
+        the split is inoperable (caller skips the prefill phase)."""
+        if not self.disaggregated(group):
+            self.degraded_picks += 1
+            return None
+        cands = self._role_pool(group, "prefill") or self._role_pool(
+            group, "both")
+        return self._least_pending(cands)
+
+    def pick_for_request(self, group: str, body: dict,
+                         exclude: set[int] = frozenset()) -> Upstream:
+        """Decode-pool pick for the generation half. Requests WITHOUT a
+        handoff (the prefill phase failed, or the split is inoperable)
+        prefer full replicas: a pure-decode replica would pay a local
+        re-prefill, and a pure-prefill replica would carry a long-lived
+        decode stream that poisons the prefill autoscaler's pending
+        signal."""
+        handed_off = bool((body or {}).get("kv_transfer_params"))
+        if not handed_off:
+            if not self.disaggregated(group):
+                return self.pick(group, exclude=exclude)
+            self.degraded_picks += 1
+            for pool in ("both", "decode"):
+                cands = [u for u in self._role_pool(group, pool)
+                         if id(u) not in exclude]
+                if cands:
+                    return self._least_pending(cands)
+            return self.pick(group, exclude=exclude)
+        cands = [u for u in (self._role_pool(group, "decode")
+                             or self._role_pool(group, "both"))
+                 if id(u) not in exclude]
+        # mixed-model pools (|MODEL renames): the entry was published
+        # under ONE model's namespace — a decode replica serving a
+        # different model can never claim it, so constrain the pick to
+        # matching replicas when any exist
+        xfer = (body or {}).get("kv_transfer_params") or {}
+        xmodel = xfer.get("model")
+        if xmodel is not None:
+            matching = [u for u in cands if u.model == xmodel]
+            if matching:
+                cands = matching
+        if not cands:
+            # every decode-capable upstream tried/cooled: fall back to
+            # the whole group rather than failing the request
+            self.degraded_picks += 1
+            return self.pick(group, exclude=exclude)
+        return self._least_pending(cands)
 
 
 @dataclass(frozen=True)
@@ -361,6 +475,9 @@ class Gateway:
         self.requests_total = 0
         self.failures_total = 0
         self.fallbacks_total = 0
+        self.handoff_total = 0         # prefill phases that published KV
+        self.handoff_failed_total = 0  # prefill phases that errored (degraded)
+        self._disagg_model_warned: set = set()
         self._httpd: ThreadingHTTPServer | None = None
         self._health_thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -410,6 +527,79 @@ class Gateway:
                 with upstream.lock:
                     upstream.pending -= 1
 
+    def _disagg_prefill(self, group: str, body: dict) -> dict:
+        """Phase one of disaggregated dispatch: have a prefill-pool
+        replica compute and pin the prompt KV, and return the body the
+        decode-pool forward should carry (``kv_transfer_params``). Any
+        failure degrades to the plain single-phase path — the body comes
+        back unchanged and whichever upstream serves it prefills
+        locally (the decode replica counts that)."""
+        pick_prefill = getattr(self.router, "pick_prefill", None)
+        if pick_prefill is None:
+            return body
+        upstream = pick_prefill(group)
+        if upstream is None:
+            return body
+        # the handoff namespace is the MODEL name: a prefill upstream
+        # publishing as m1 can never be claimed by a decode upstream
+        # serving m2 — every handoff would silently expire as 'lost'
+        # while doubling prefill cost. Skip the phase (warned once).
+        dec_models = {u.model
+                      for u in (self.router._role_pool(group, "decode")
+                                or self.router._role_pool(group, "both"))}
+        if dec_models and upstream.model not in dec_models:
+            if group not in self._disagg_model_warned:
+                self._disagg_model_warned.add(group)
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "disagg disabled for group %r: prefill upstream "
+                    "serves model %r but the decode pool serves %s — "
+                    "handoff namespaces would never match; fix the "
+                    "--upstream model names",
+                    group, upstream.model, sorted(dec_models))
+            self.handoff_failed_total += 1
+            return body
+        req = urllib.request.Request(
+            f"{upstream.base_url}/internal/handoff/prefill",
+            data=json.dumps({"messages": body.get("messages", []),
+                             "model": upstream.model}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        # the prefill call occupies the replica exactly like a
+        # completion does — least-pending over the prefill pool needs it
+        with upstream.lock:
+            upstream.pending += 1
+            upstream.served += 1
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                resp = json.loads(r.read())
+            hid = resp["handoff_id"]
+        except urllib.error.HTTPError as e:
+            # 501 = this replica/model cannot prefill for handoff (e.g.
+            # a LoRA adapter engine without a handoff store) — the
+            # upstream is HEALTHY, so don't feed the circuit breaker:
+            # cooling it down would pull it from rotation for every
+            # model it serves
+            if e.code != 501:
+                upstream.record_failure(time.time())
+            self.handoff_failed_total += 1
+            return body
+        except (urllib.error.URLError, TimeoutError, OSError,
+                ValueError, KeyError):
+            upstream.record_failure(time.time())
+            self.handoff_failed_total += 1
+            return body
+        finally:
+            with upstream.lock:
+                upstream.pending -= 1
+        upstream.record_success()
+        self.handoff_total += 1
+        # the model rides along: the handoff namespace IS the model
+        # name, so the decode pick must prefer replicas serving it
+        return dict(body, kv_transfer_params={"handoff_id": hid,
+                                              "model": upstream.model})
+
     def _estimate_tokens(self, body: dict) -> int:
         chars = sum(len(str(m.get("content", "")))
                     for m in body.get("messages", []))
@@ -458,22 +648,31 @@ class Gateway:
                 chain = cw + [g for g in chain if g not in cw]
                 self.fallbacks_total += 1
 
+        # disaggregated dispatch (DisaggRouter only): prefill the prompt
+        # at the prefill pool first; the forwarded body then carries the
+        # handoff id. Only the primary group gets it — a fallback group
+        # is a different model whose KV namespace cannot use this entry.
+        handoff_body = (self._disagg_prefill(group, body)
+                        if chain and chain[0] == group else body)
+
         last_status, last_detail = 502, {"error": {"message": "no upstream"}}
         for gi, g in enumerate(chain):
             if gi > 0:
                 self.fallbacks_total += 1
+            g_body = handoff_body if g == group else body
             tried: set[int] = set()
             retriable = True
             while True:
                 try:
                     upstream = self.router.pick_for_request(
-                        g, body, exclude=tried)
+                        g, g_body, exclude=tried)
                 except RouterError:
                     break
                 tried.add(id(upstream))
                 attempts = 0
                 while True:
-                    status, resp = self._forward(upstream, body, stream=stream)
+                    status, resp = self._forward(upstream, g_body,
+                                                 stream=stream)
                     if status == 200:
                         upstream.record_success()
                         if stream:
@@ -548,12 +747,28 @@ class Gateway:
                     "# TYPE gateway_cache_skipped_total counter",
                     f"gateway_cache_skipped_total {skipped}",
                 ]
+        if self.handoff_total or self.handoff_failed_total or hasattr(
+                self.router, "pick_prefill"):
+            degraded = getattr(self.router, "degraded_picks", 0)
+            lines += [
+                "# TYPE gateway_handoff_total counter",
+                f"gateway_handoff_total {self.handoff_total}",
+                "# TYPE gateway_handoff_failed_total counter",
+                f"gateway_handoff_failed_total {self.handoff_failed_total}",
+                "# TYPE gateway_disagg_degraded_total counter",
+                f"gateway_disagg_degraded_total {degraded}",
+            ]
         now = time.time()
         for u in self.router.upstreams:
-            label = f'{{group="{u.group}",url="{u.base_url}"}}'
+            label = (f'{{group="{u.group}",url="{u.base_url}"'
+                     f',role="{u.role}"}}')
             lines += [
                 f"gateway_upstream_pending{label} {u.pending}",
                 f"gateway_upstream_available{label} {int(u.available(now))}",
+                f"gateway_upstream_picks_total{label} {u.picks}",
+                f"gateway_upstream_cooldowns_total{label} {u.cooldowns}",
+                f"gateway_upstream_affinity_hits_total{label} "
+                f"{u.affinity_hits}",
             ]
         return "\n".join(lines) + "\n"
 

@@ -36,9 +36,11 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 
 import numpy as np
 
+from llm_in_practise_tpu.obs.logging import get_logger
 from llm_in_practise_tpu.serve.prefix_cache import PrefixLRU
 
 try:  # ml_dtypes ships with jax; it provides the numpy bfloat16 scalar type
@@ -185,6 +187,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _recv_prelude(sock: socket.socket) -> bytes | None:
+    """The 8-byte length prelude, or ``None`` on a clean close (EOF at a
+    message boundary — a client hanging up between requests is normal
+    connection lifecycle, not a protocol fault)."""
+    first = sock.recv(1)
+    if not first:
+        return None
+    return first + _recv_exact(sock, 7)
+
+
 def _send_msg(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
     head = json.dumps(header).encode()
     sock.sendall(struct.pack("<II", len(head), len(payload)) + head + payload)
@@ -194,8 +206,10 @@ def _recv_msg(
     sock: socket.socket, *,
     max_header: int = MAX_HEADER_BYTES,
     max_payload: int = MAX_PAYLOAD_BYTES,
+    prelude: bytes | None = None,
 ) -> tuple[dict, bytes]:
-    hlen, plen = struct.unpack("<II", _recv_exact(sock, 8))
+    hlen, plen = struct.unpack(
+        "<II", prelude if prelude is not None else _recv_exact(sock, 8))
     if hlen > max_header or plen > max_payload:
         raise ConnectionError(
             f"kv pool message exceeds caps (header {hlen} > {max_header} or "
@@ -234,7 +248,9 @@ class KVPoolServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  max_tokens: int = 1 << 22, min_prefix: int = 16,
                  max_bytes: int = 4 << 30, max_namespaces: int = 64,
-                 max_payload: int = MAX_PAYLOAD_BYTES):
+                 max_payload: int = MAX_PAYLOAD_BYTES,
+                 handoff_ttl_s: float = 120.0,
+                 max_handoff_bytes: int = 1 << 30, clock=None):
         self.min_prefix = min_prefix
         self.max_tokens = max_tokens
         self.max_bytes = max_bytes
@@ -242,6 +258,31 @@ class KVPoolServer:
         self.max_payload = min(max_payload, max_bytes)
         self.rejected = 0             # puts refused (ns budget / size caps)
         self._unknown_ns_misses = 0   # gets for namespaces never put to
+        # per-connection fault containment: protocol/transport faults are
+        # logged and counted, and tear down THAT connection only — the
+        # handler thread must never unwind silently (a fleet of serving
+        # pods debugging "the pool sometimes loses entries" deserves a
+        # counter and a log line, not a vanished thread)
+        self.conn_errors = 0
+        self._log = get_logger("serve.kv_pool")
+        # --- handoff store (disaggregated prefill→decode KV transfer) ---
+        # Entries here are PINNED: they live outside the LRU store, so no
+        # amount of put pressure can evict one before the decode replica
+        # claims it (the claim race the pin exists to close). The bound
+        # is instead temporal + byte-budget: unclaimed entries expire
+        # after handoff_ttl_s (the decode side treats a miss as "lost"
+        # and re-prefills), and puts beyond max_handoff_bytes are
+        # refused so a crashed decode pool cannot pin unbounded RAM.
+        self.handoff_ttl_s = handoff_ttl_s
+        self.max_handoff_bytes = max_handoff_bytes
+        self._clock = clock or time.monotonic
+        # (ns, id) -> (expires_at, length, bucket, blob)
+        self._handoff: dict[tuple[str, str], tuple[float, int, int, bytes]] = {}
+        self._handoff_bytes = 0
+        self.handoff_puts = 0
+        self.handoff_claims = 0
+        self.handoff_expired = 0
+        self.handoff_rejected = 0
         self._namespaces: set[str] = set()
         # live entries per namespace: a namespace whose last entry is
         # evicted releases its slot (rolling model redeploys would
@@ -263,13 +304,26 @@ class KVPoolServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                try:
-                    while True:
+                while True:
+                    try:
+                        prelude = _recv_prelude(self.request)
+                    except (ConnectionError, OSError) as e:
+                        # reset mid-prelude: a transport fault, not a
+                        # clean between-messages hangup
+                        pool._conn_fault(self.client_address, e)
+                        return
+                    if prelude is None:
+                        return            # clean close between messages
+                    try:
                         header, payload = _recv_msg(
-                            self.request, max_payload=pool.max_payload)
+                            self.request, max_payload=pool.max_payload,
+                            prelude=prelude)
                         pool._dispatch(self.request, header, payload)
-                except (ConnectionError, OSError, ValueError, KeyError):
-                    return
+                    except Exception as e:  # noqa: BLE001 — malformed
+                        # header, over-cap frame, mid-read EOF, bad op
+                        # args: contain the fault to THIS connection
+                        pool._conn_fault(self.client_address, e)
+                        return
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -289,6 +343,12 @@ class KVPoolServer:
         self._server.server_close()
 
     # -- ops ----------------------------------------------------------------
+
+    def _conn_fault(self, peer, exc) -> None:
+        self.conn_errors += 1
+        self._log.warning(
+            "kv pool connection from %s closed on fault #%d: %s: %s",
+            peer, self.conn_errors, type(exc).__name__, exc)
 
     def _on_evict(self, key, value) -> None:
         with self._acct_lock:
@@ -317,10 +377,25 @@ class KVPoolServer:
                 length, bucket, blob = found
                 _send_msg(sock, {"found": True, "length": length,
                                  "bucket": bucket}, blob)
+        elif op == "hput":
+            ok, why = self._handoff_put(ns, str(header["id"]),
+                                        int(header["length"]),
+                                        int(header["bucket"]), payload)
+            _send_msg(sock, {"ok": ok} if ok else {"ok": False, "error": why})
+        elif op == "hclaim":
+            found = self._handoff_claim(ns, str(header["id"]))
+            if found is None:
+                _send_msg(sock, {"found": False})
+            else:
+                length, bucket, blob = found
+                _send_msg(sock, {"found": True, "length": length,
+                                 "bucket": bucket}, blob)
         elif op == "stats":
             with self._acct_lock:
                 total_bytes = self._total_bytes
                 n_ns = len(self._namespaces)
+                handoff_pending = len(self._handoff)
+                handoff_bytes = self._handoff_bytes
             _send_msg(sock, {
                 "entries": self._store.n_entries,
                 # ns key element is bookkeeping, not a cached token
@@ -329,6 +404,13 @@ class KVPoolServer:
                 "cached_bytes": total_bytes,
                 "hits": self.hits, "misses": self.misses,
                 "namespaces": n_ns, "rejected": self.rejected,
+                "conn_errors": self.conn_errors,
+                "handoff_pending": handoff_pending,
+                "handoff_bytes": handoff_bytes,
+                "handoff_puts": self.handoff_puts,
+                "handoff_claims": self.handoff_claims,
+                "handoff_expired": self.handoff_expired,
+                "handoff_rejected": self.handoff_rejected,
             })
         else:
             _send_msg(sock, {"ok": False, "error": f"unknown op {op!r}"})
@@ -397,6 +479,57 @@ class KVPoolServer:
         key_len, bucket, blob = found
         return key_len - 1, bucket, blob
 
+    # -- handoff (disaggregated serving) --------------------------------------
+
+    def _sweep_handoff_locked(self, now: float) -> None:
+        """Reclaim expired handoff entries — the TTL is the only eviction
+        pressure pinned entries feel. Caller holds ``_acct_lock``."""
+        dead = [k for k, v in self._handoff.items() if v[0] <= now]
+        for k in dead:
+            self._handoff_bytes -= len(self._handoff.pop(k)[3])
+            self.handoff_expired += 1
+
+    def _handoff_put(self, ns: str, hid: str, length: int, bucket: int,
+                     blob: bytes) -> tuple[bool, str]:
+        # per-entry size is already bounded at the framing layer
+        # (_recv_msg caps payloads at max_payload before dispatch);
+        # the budget below is the only handoff-specific bound
+        now = self._clock()
+        with self._acct_lock:
+            self._sweep_handoff_locked(now)
+            old = self._handoff.get((ns, hid))
+            freed = len(old[3]) if old is not None else 0
+            if (self._handoff_bytes - freed + len(blob)
+                    > self.max_handoff_bytes):
+                # refuse, don't evict: every pinned entry has a decode
+                # replica about to claim it — dropping one to admit
+                # another just moves the re-prefill around. The refusal
+                # surfaces at the prefill replica as a publish failure
+                # and the request degrades to local prefill.
+                self.handoff_rejected += 1
+                return False, "handoff byte budget exhausted"
+            self._handoff_bytes += len(blob) - freed
+            self._handoff[(ns, hid)] = (
+                now + self.handoff_ttl_s, length, bucket, blob)
+            self.handoff_puts += 1
+        return True, ""
+
+    def _handoff_claim(self, ns: str, hid: str):
+        now = self._clock()
+        with self._acct_lock:
+            self._sweep_handoff_locked(now)
+            found = self._handoff.pop((ns, hid), None)
+            if found is None:
+                return None
+            _, length, bucket, blob = found
+            self._handoff_bytes -= len(blob)
+            self.handoff_claims += 1
+        return length, bucket, blob
+
+
+class HandoffRejected(RuntimeError):
+    """The pool refused to pin a handoff entry (size/budget caps)."""
+
 
 class RemoteKVClient:
     """One engine's handle on a :class:`KVPoolServer` (connection per call —
@@ -440,6 +573,33 @@ class RemoteKVClient:
     def stats(self) -> dict:
         header, _ = self._call({"op": "stats"})
         return header
+
+    # -- handoff (disaggregated serving) --------------------------------------
+
+    def handoff_put(self, handoff_id: str, host: HostEntry) -> None:
+        """Pin ``host`` under ``handoff_id`` until a decode replica claims
+        it (or the pool's TTL reclaims it). Raises :class:`HandoffRejected`
+        when the pool refuses the pin — unlike :meth:`put`, the caller
+        MUST know, because a router is about to point a decode replica at
+        this entry."""
+        header, _ = self._call(
+            {"op": "hput", "ns": self.namespace, "id": handoff_id,
+             "length": host.length, "bucket": host.bucket},
+            encode_entry(host))
+        if not header.get("ok"):
+            raise HandoffRejected(header.get("error", "handoff put refused"))
+
+    def handoff_claim(self, handoff_id: str,
+                      timeout: float | None = None) -> HostEntry | None:
+        """Claim-and-remove a pinned handoff entry; ``None`` = lost
+        (expired, never published, or already claimed) — the caller
+        re-prefills locally."""
+        header, payload = self._call(
+            {"op": "hclaim", "ns": self.namespace, "id": handoff_id},
+            timeout=timeout)
+        if not header.get("found"):
+            return None
+        return decode_entry(payload)
 
 
 # --- the facade the engine holds -------------------------------------------

@@ -362,6 +362,53 @@ def test_streaming_relayed_through_gateway():
         up.close()
 
 
+def test_per_upstream_metrics_counters(fakes):
+    """/metrics must expose per-upstream picks / cooldowns /
+    affinity_hits so an operator can see WHERE the router sends traffic
+    and which replicas keep tripping the breaker."""
+    good = fakes("good")
+    bad = fakes("bad", script=[500] * 10)
+    u_good = Upstream(good.base_url, "good", group="chat", weight=0.1)
+    u_bad = Upstream(bad.base_url, "bad", group="chat",
+                     allowed_fails=2, cooldown_time=60)
+    gw = make_gateway([u_good, u_bad])
+    for _ in range(4):
+        status, _ = gw.handle_completion(_req({"model": "chat"}))
+        assert status == 200
+    assert u_bad.cooldowns == 1          # tripped once after 2 fails
+    assert u_good.picks >= 1 and u_bad.picks >= 1
+    text = gw.metrics_text()
+    assert (f'gateway_upstream_picks_total{{group="chat",'
+            f'url="{u_good.base_url}",role="both"}} '
+            f"{u_good.picks}") in text
+    assert (f'gateway_upstream_cooldowns_total{{group="chat",'
+            f'url="{u_bad.base_url}",role="both"}} 1') in text
+    assert "gateway_upstream_affinity_hits_total" in text
+
+
+def test_affinity_hits_counted_per_upstream(fakes):
+    from llm_in_practise_tpu.serve.gateway import PrefixAffinityRouter
+
+    a, b = fakes("a"), fakes("b")
+    ua = Upstream(a.base_url, "a", group="chat")
+    ub = Upstream(b.base_url, "b", group="chat")
+    gw = Gateway(PrefixAffinityRouter([ua, ub]), health_check_interval_s=0,
+                 retry_policy=RetryPolicy(backoff_s=0.01))
+    conv = {"model": "chat", "messages": [
+        {"role": "system", "content": "sys"},
+        {"role": "user", "content": "first"}]}
+    for _ in range(3):
+        status, _ = gw.handle_completion(dict(conv))
+        assert status == 200
+    # first pick establishes the pin; the next two are affinity hits
+    assert ua.affinity_hits + ub.affinity_hits == 2
+    assert ua.picks + ub.picks == 3
+    text = gw.metrics_text()
+    pinned = ua if ua.affinity_hits else ub
+    assert (f'gateway_upstream_affinity_hits_total{{group="chat",'
+            f'url="{pinned.base_url}",role="both"}} 2') in text
+
+
 def test_prefix_affinity_routing(fakes):
     """Same conversation -> same upstream (cache-aware); new conversations
     spread; cooldown overrides stickiness."""

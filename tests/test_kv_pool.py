@@ -444,6 +444,96 @@ def test_pool_server_replacement_put_accounts_once():
         server.stop()
 
 
+def test_l3_dies_mid_offload_fail_open_then_recovers(rng):
+    """TieredKV fail-open (the LMCache availability story): the L3
+    server dying mid-flight must degrade the serving path to a miss
+    within one cooldown — never an exception, never a per-request
+    connect stall — and once the server returns (cooldown elapsed) the
+    remote tier serves hits again."""
+    from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+
+    model, params = _tiny_model(rng)
+    clock = {"t": 0.0}
+    server = KVPoolServer(min_prefix=8).start()
+    host, port = server.address
+    pool = TieredKV(
+        HostKVPool(min_prefix=8),
+        RemoteKVClient((host, port), timeout=1.0),
+        async_offload=False,          # offload failures surface inline
+        remote_cooldown_s=30.0, clock=lambda: clock["t"],
+    )
+    engine = InferenceEngine(
+        model, params, max_slots=2, cache_len=128, cache_dtype=jnp.float32,
+        prefix_cache=PrefixCache(max_tokens=40, min_prefix=8),  # tiny L1
+        kv_pool=pool,
+    )
+    sp = SamplingParams(greedy=True, max_tokens=6)
+    cold = engine.generate(PROMPT_A, sp)
+    assert server._entries       # write-through reached the live server
+
+    # kill the server: the next write-through offload hits a dead socket
+    server.stop()
+    out_b = engine.generate(PROMPT_B, sp)      # offload fails open
+    assert len(out_b) == 6
+    assert pool.remote_errors >= 1
+    # A was evicted from the tiny L1 by B, its host copy serves the
+    # re-hit; remote lookups are skipped inside the cooldown (no stall)
+    errors_before = pool.remote_errors
+    assert engine.generate(PROMPT_A, sp) == cold
+    pool.host_pool.clear()
+    assert pool.lookup([9, 9, 9, 9, 9, 9, 9, 9, 9]) is None
+    assert pool.remote_errors == errors_before  # breaker open: no attempt
+
+    # server returns on the SAME address; after the cooldown the remote
+    # tier is probed again and serves the shared entry
+    revived = KVPoolServer(host, port, min_prefix=8).start()
+    try:
+        client = RemoteKVClient((host, port))
+        client.put(PROMPT_A, _host_entry(length=32, bucket=32))
+        clock["t"] = 31.0                       # cooldown elapsed
+        hit = pool.lookup(PROMPT_A)
+        assert hit is not None and hit.length == 32
+        assert revived.hits >= 1
+    finally:
+        revived.stop()
+
+
+def test_pool_server_contains_connection_faults():
+    """A malformed header, an over-cap frame, or a mid-read EOF must
+    log + count + close THAT connection only — the server stays healthy
+    and a clean between-messages hangup is not an error."""
+    import socket
+    import struct
+
+    server = KVPoolServer(min_prefix=4, max_payload=1 << 16).start()
+    try:
+        # malformed header: valid framing, garbage JSON
+        with socket.create_connection(server.address, timeout=2.0) as s:
+            s.sendall(struct.pack("<II", 7, 0) + b"not{json")
+            assert s.recv(1) == b""            # that connection closed
+        # over-cap frame
+        with socket.create_connection(server.address, timeout=2.0) as s:
+            s.sendall(struct.pack("<II", 8, (1 << 32) - 1) + b'{"op":1}')
+            assert s.recv(1) == b""
+        # mid-read EOF: declare a 64-byte header, send 3 bytes, hang up
+        with socket.create_connection(server.address, timeout=2.0) as s:
+            s.sendall(struct.pack("<II", 64, 0) + b"abc")
+        # clean close between messages: no bytes at all
+        with socket.create_connection(server.address, timeout=2.0):
+            pass
+        deadline = __import__("time").time() + 5
+        while server.conn_errors < 3 and __import__("time").time() < deadline:
+            __import__("time").sleep(0.05)
+        assert server.conn_errors == 3, server.conn_errors
+        # the server still serves well-formed clients
+        client = RemoteKVClient(server.address, namespace="m")
+        client.put(list(range(16)), _host_entry(length=16, bucket=16))
+        assert client.get(list(range(20))) is not None
+        assert client.stats()["conn_errors"] == 3
+    finally:
+        server.stop()
+
+
 def test_gateway_metrics_with_remote_cache():
     """/metrics must render when the gateway holds a RemoteResponseCache."""
     from llm_in_practise_tpu.serve.cache_service import RemoteResponseCache
