@@ -811,6 +811,31 @@ def bench_gptlike(peak: float) -> dict:
         "gptlike bench failed everywhere:\n" + "\n".join(errors))
 
 
+def obs_snapshot(server=None) -> dict:
+    """Observability snapshot attached to every BENCH_* artifact: the
+    process trace-ring summary (per-span-name counts and total seconds
+    — the dispatch/latency breakdown behind the headline number) plus,
+    when a serving stack is in the loop, its full /metrics exposition.
+    A perf regression with this block attached says WHERE the time
+    went; one without it is a wall-clock guess."""
+    snap = {}
+    try:
+        from llm_in_practise_tpu.obs.trace import get_tracer
+
+        snap["trace_summary"] = get_tracer().summary()
+    except Exception as e:  # noqa: BLE001 — a bad LLM_TPU_TRACE_FILE
+        # (first get_tracer() can happen here) must not kill hours of
+        # completed benching at artifact-assembly time
+        snap["trace_error"] = f"{type(e).__name__}: {e}"
+    if server is not None:
+        try:
+            snap["metrics"] = server.metrics_text()
+        except Exception as e:  # noqa: BLE001 — a scrape failure must
+            # not kill the artifact
+            snap["metrics_error"] = f"{type(e).__name__}: {e}"
+    return snap
+
+
 def main() -> None:
     init_backend_with_retry()
     kind, peak = chip_peak()
@@ -826,6 +851,7 @@ def main() -> None:
             "peak_bf16_flops": peak,
             "qlora": q,
             "gptlike_pretrain": g,
+            "observability": obs_snapshot(),
         },
     }))
 

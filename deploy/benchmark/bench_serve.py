@@ -168,6 +168,7 @@ def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
     """
     import queue as queue_mod
 
+    from llm_in_practise_tpu.obs.trace import new_context
     from llm_in_practise_tpu.serve import engine as engine_mod
     from llm_in_practise_tpu.serve.engine import SamplingParams
 
@@ -184,9 +185,13 @@ def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
                     return
                 i = queue.pop()
             try:
+                # each bench request is a traced root: without this the
+                # direct-engine path records no spans and the artifact's
+                # obs_snapshot trace summary would be structurally empty
                 req = engine.submit(prompt_ids_list[picks[i]],
                                     SamplingParams(greedy=True,
-                                                   max_tokens=max_tokens))
+                                                   max_tokens=max_tokens),
+                                    trace=new_context())
                 while True:  # drain the stream; bounded wait per token
                     item = req.tokens.get(timeout=timeout)
                     if item is engine_mod._FINISH:

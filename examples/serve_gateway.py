@@ -72,9 +72,21 @@ def main():
                    help="scale each group between MIN and MAX replicas "
                         "toward TARGET ongoing requests per replica "
                         "(requires --standby capacity above MIN)")
+    p.add_argument("--trace-file", dest="trace_file", default=None,
+                   metavar="PATH",
+                   help="append Chrome trace events (one JSON per line) "
+                        "for every routed request's spans to PATH — "
+                        "open in Perfetto; the span ring is always on "
+                        "at GET /debug/traces")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=4000)
     args = p.parse_args()
+
+    if args.trace_file:
+        from llm_in_practise_tpu.obs.trace import get_tracer
+
+        get_tracer().set_trace_file(args.trace_file)
+        print(f"chrome trace events -> {args.trace_file}")
 
     upstreams = []
     # default pairs with examples/serve_openai.py's default model_name
@@ -159,7 +171,8 @@ def main():
     for u in upstreams:
         tag = "" if u.role == "both" else f", role {u.role}"
         print(f"upstream {u.group}: {u.base_url} (weight {u.weight}{tag})")
-    print(f"gateway on {args.host}:{args.port}")
+    print(f"gateway on {args.host}:{args.port} "
+          f"(/v1/chat/completions, /health, /metrics, /debug/traces)")
     try:
         gw.serve(host=args.host, port=args.port)
     finally:

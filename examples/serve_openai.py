@@ -108,6 +108,13 @@ def main():
                    help="admission control: shed requests that waited "
                         "past this deadline (HTTP 429 queue_full) — the "
                         "gateway's retry policy routes them elsewhere")
+    p.add_argument("--trace-file", dest="trace_file", default=None,
+                   metavar="PATH",
+                   help="append Chrome trace events (one JSON per line) "
+                        "for every request span to PATH — open in "
+                        "Perfetto / chrome://tracing; the in-memory "
+                        "span ring is always on at GET /debug/traces "
+                        "(LLM_TPU_TRACE=off disables tracing)")
     p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype",
                    default="float32", choices=["float32", "bfloat16", "fp8"],
                    help="KV cache storage dtype; fp8 (e4m3) halves KV HBM "
@@ -296,11 +303,18 @@ def main():
             **adapter_kw
         )
         print(f"adapters: {sorted(adapters)}")
+    if args.trace_file:
+        from llm_in_practise_tpu.obs.trace import get_tracer
+
+        get_tracer().set_trace_file(args.trace_file)
+        print(f"chrome trace events -> {args.trace_file} "
+              "(open in Perfetto)")
     server = OpenAIServer(engine, tok, model_name=args.model_name,
                           adapters=adapters, role=args.role,
                           handoff=handoff)
     print(f"serving on {args.host}:{args.port} "
-          f"(/v1/chat/completions, /v1/models, /health, /metrics)")
+          f"(/v1/chat/completions, /v1/models, /health, /metrics, "
+          f"/debug/traces)")
     server.serve(host=args.host, port=args.port)
 
 

@@ -1,9 +1,13 @@
-"""Observability: coordinator-gated logging, step metrics, profiling hooks.
+"""Observability: coordinator-gated logging, step metrics, profiling hooks,
+the unified metrics registry, and the request-span tracer.
 
 SURVEY §5.1/§5.5 — the reference's logging/metrics surface (env-level
 logging, rank-0 gating, rolling loss, epoch timing) plus the profiling it
-lacks; serving-side Prometheus metrics live with the server in
-:mod:`llm_in_practise_tpu.serve.api`.
+lacks. Serving-side Prometheus exposition renders through
+:mod:`llm_in_practise_tpu.obs.registry` (every server builds a
+:class:`~llm_in_practise_tpu.obs.registry.Registry` over its live
+counters); cross-hop request tracing lives in
+:mod:`llm_in_practise_tpu.obs.trace` (see docs/observability.md).
 """
 
 from llm_in_practise_tpu.obs.logging import get_logger, setup_logging  # noqa: F401
@@ -19,4 +23,20 @@ from llm_in_practise_tpu.obs.meter import (  # noqa: F401
     RollingMean,
     Throughput,
     profile_trace,
+)
+from llm_in_practise_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    HistogramAccumulator,
+    LATENCY_BUCKETS_S,
+    Registry,
+)
+from llm_in_practise_tpu.obs.trace import (  # noqa: F401
+    TraceContext,
+    Tracer,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    set_tracer,
 )

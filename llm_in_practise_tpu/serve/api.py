@@ -13,10 +13,16 @@ Parity with the reference's FastAPI server
 - usage accounting (``:118-152``), ``GET /v1/models``, ``GET /health``.
 - ``POST /v1/embeddings`` — mean-pooled hidden states (the embedding
   service the reference's semantic cache / RAG stack call out to).
-- ``GET /metrics`` — Prometheus text exposition with the platform's canonical
-  serving metrics (queue depth, running requests, TTFT/TPOT quantiles —
-  mirroring the PromQL table ``LLM_on_Kubernetes/Inference_Platfrom/
-  README.md:1676-1692``).
+- ``GET /metrics`` — Prometheus text exposition rendered by the unified
+  registry (:mod:`llm_in_practise_tpu.obs.registry`): queue depth, running
+  requests, bucketed TTFT/TPOT histograms — mirroring the PromQL table
+  ``LLM_on_Kubernetes/Inference_Platfrom/README.md:1676-1692``; see
+  docs/observability.md for the catalog.
+- ``GET /debug/traces`` — the request-span ring
+  (:mod:`llm_in_practise_tpu.obs.trace`): per-request spans for queue
+  wait, admission, prefill chunks, decode, handoff publish/claim, and
+  stream flush, correlated across the gateway and the disaggregated
+  replicas by a ``traceparent``-propagated trace id.
 
 Built on the stdlib ``ThreadingHTTPServer`` — the serving runtime carries no
 web-framework dependency; each connection gets an OS thread, generation
@@ -27,27 +33,25 @@ from __future__ import annotations
 
 import html
 import json
+import sys
 import threading
+import time
 from http.server import ThreadingHTTPServer
 
 import numpy as np
 
 from llm_in_practise_tpu.data.sft import IM_START, render_chatml
+from llm_in_practise_tpu.obs.registry import Registry
+from llm_in_practise_tpu.obs.trace import get_tracer, parse_traceparent
 from llm_in_practise_tpu.serve import schemas
 from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
-from llm_in_practise_tpu.serve.http_util import JsonHandler
+from llm_in_practise_tpu.serve.http_util import JsonHandler, serve_obs_get
 
 
 def build_prompt(messages) -> str:
     """OpenAI messages -> ChatML generation prompt (reference ``:37-57``)."""
     rendered = render_chatml([{"role": m.role, "content": m.content} for m in messages])
     return rendered + f"\n{IM_START}assistant\n"
-
-
-def _quantile(values, q):
-    if not values:
-        return 0.0
-    return float(np.quantile(np.asarray(values), q))
 
 
 class OpenAIServer:
@@ -63,6 +67,7 @@ class OpenAIServer:
         adapters: dict[str, InferenceEngine] | None = None,
         role: str = "both",
         handoff=None,
+        tracer=None,
     ):
         from llm_in_practise_tpu.obs.meter import HandoffMeter
         from llm_in_practise_tpu.serve.disagg import validate_roles
@@ -91,6 +96,15 @@ class OpenAIServer:
         # engines may carry different modules, and a pooler closing over
         # one engine's model must never run another's params
         self._embed_fns: dict[int, object] = {}
+        # request tracing (obs/trace.py): the API layer mints/extends the
+        # per-request TraceContext; the engine parents its phase spans to
+        # it. Default = the process tracer, so colocated components share
+        # one ring and GET /debug/traces sees the whole request.
+        self.tracer = tracer if tracer is not None else get_tracer()
+        # unified metrics registry (obs/registry.py): scrape-time
+        # callbacks over the live engine/meter counters — the ONE
+        # exposition renderer, replacing the hand-formatted text block
+        self.registry = self._build_registry()
 
     def engine_for(self, model: str | None) -> InferenceEngine | None:
         if model in (None, "", self.model_name):
@@ -169,13 +183,15 @@ class OpenAIServer:
             "usage": {"prompt_tokens": total, "total_tokens": total},
         })
 
-    def handle_prefill(self, body: dict, send_json):
+    def handle_prefill(self, body: dict, send_json, trace=None):
         """``POST /internal/handoff/prefill`` — the prefill half of
         disaggregated serving (serve/disagg.py). Runs prefill only,
         publishes the prompt KV into the handoff store, and returns the
         handoff id the router passes to a decode replica via
         ``kv_transfer_params``. Internal: only the gateway calls this
-        (it is absent on pure-decode replicas)."""
+        (it is absent on pure-decode replicas). ``trace``: the gateway's
+        TraceContext (from the ``traceparent`` header) — the prefill
+        phase's engine spans join the request's trace."""
         from llm_in_practise_tpu.serve.disagg import new_handoff_id
 
         if self.role == "decode":
@@ -204,33 +220,50 @@ class OpenAIServer:
                 "type": "unsupported_error"}})
         prompt_ids = self.tokenizer.encode(self.prompt_builder(req.messages))
         hid = new_handoff_id()
-        handle = engine.submit(prompt_ids, SamplingParams(max_tokens=1),
-                               handoff_id=hid)
+        span = self.tracer.start_span("api.prefill", parent=trace,
+                                      model=req.model, handoff_id=hid)
         from llm_in_practise_tpu.serve.engine import EngineDeadError
 
+        outcome = "error"  # the span's finish_reason mirrors the HTTP
+        # outcome (handle.finish_reason is None on engine death and
+        # partial on sheds — /debug/traces must say what the caller saw)
         try:
-            handle.result()    # drains to _FINISH; prefill emits no tokens
-        except EngineDeadError:
-            return send_json(503, {"error": {
-                "message": "engine is not running", "type": "internal_error",
-                "code": "engine_dead"}})
-        if handle.finish_reason == "queue_full":
-            return send_json(429, {"error": {
-                "message": "prefill queue full — retry another replica",
-                "type": "rate_limit_error", "code": "queue_full"}})
-        if handle.finish_reason != "handoff":
-            return send_json(503, {"error": {
-                "message": "KV publish failed (pool unreachable or "
-                           "handoff budget exhausted) — serve this "
-                           "request undisaggregated",
-                "type": "internal_error", "code": "handoff_failed"}})
-        return send_json(200, {
-            "handoff_id": hid,
-            "prompt_tokens": len(handle.prompt_ids),
-            "model": req.model,
-        })
+            # inside the span's try: a submit failure (bad prompt, dead
+            # engine thread) must end the span as an error, not leak it
+            # unrecorded while do_POST answers 500
+            handle = engine.submit(prompt_ids, SamplingParams(max_tokens=1),
+                                   handoff_id=hid, trace=span.context())
+            try:
+                handle.result()  # drains to _FINISH; prefill emits no
+                # tokens
+            except EngineDeadError:
+                outcome = "engine_dead"
+                return send_json(503, {"error": {
+                    "message": "engine is not running",
+                    "type": "internal_error",
+                    "code": "engine_dead"}})
+            if handle.finish_reason == "queue_full":
+                outcome = "queue_full"
+                return send_json(429, {"error": {
+                    "message": "prefill queue full — retry another replica",
+                    "type": "rate_limit_error", "code": "queue_full"}})
+            if handle.finish_reason != "handoff":
+                outcome = "handoff_failed"
+                return send_json(503, {"error": {
+                    "message": "KV publish failed (pool unreachable or "
+                               "handoff budget exhausted) — serve this "
+                               "request undisaggregated",
+                    "type": "internal_error", "code": "handoff_failed"}})
+            outcome = "handoff"
+            return send_json(200, {
+                "handoff_id": hid,
+                "prompt_tokens": len(handle.prompt_ids),
+                "model": req.model,
+            })
+        finally:
+            span.end(finish_reason=outcome)
 
-    def handle_chat(self, body: dict, send_json, send_stream):
+    def handle_chat(self, body: dict, send_json, send_stream, trace=None):
         try:
             req = schemas.ChatCompletionRequest.from_dict(body)
         except schemas.ValidationError as e:
@@ -258,225 +291,292 @@ class OpenAIServer:
         # engine counts it, the stream is correct either way
         kv_entry = None
         xfer = body.get("kv_transfer_params")
-        if isinstance(xfer, dict) and xfer.get("handoff_id"):
-            # claim from the target MODEL's store when it has one (each
-            # model's handoff namespace is distinct — base vs adapters),
-            # else the server-level store
-            store = getattr(engine, "handoff", None) or self.handoff
-            if store is not None:
-                kv_entry = store.claim(str(xfer["handoff_id"]))
-            self.handoff_meter.claim_outcome(kv_entry is not None)
-        handle = engine.submit(prompt_ids, params, kv_entry=kv_entry)
-        req_id = schemas.completion_id()
+        # trace continuity: the traceparent header is primary; the
+        # handoff body's ride-along copy covers intermediaries that
+        # strip headers (the prefill→decode hop must stay one trace)
+        ctx = trace
+        if ctx is None and isinstance(xfer, dict) and xfer.get("trace"):
+            ctx = parse_traceparent(str(xfer["trace"]))
+        span = self.tracer.start_span(
+            "api.chat", parent=ctx, model=req.model or self.model_name,
+            stream=bool(req.stream),
+            handed_off=bool(isinstance(xfer, dict)
+                            and xfer.get("handoff_id")))
+        try:
+            if isinstance(xfer, dict) and xfer.get("handoff_id"):
+                # claim from the target MODEL's store when it has one (each
+                # model's handoff namespace is distinct — base vs adapters),
+                # else the server-level store
+                store = getattr(engine, "handoff", None) or self.handoff
+                with self.tracer.span("handoff.claim", parent=span,
+                                      handoff_id=str(xfer["handoff_id"])) as cs:
+                    if store is not None:
+                        kv_entry = store.claim(str(xfer["handoff_id"]))
+                    cs.set(found=kv_entry is not None)
+                self.handoff_meter.claim_outcome(kv_entry is not None)
+            handle = engine.submit(prompt_ids, params, kv_entry=kv_entry,
+                                   trace=span.context())
+            req_id = schemas.completion_id()
 
-        def queue_full_429(message):
-            # one shape for every shed path (max_queue at submit AND the
-            # later queue_timeout sheds): the gateway's retry policy
-            # keys on the status + code. A shed request never used its
-            # claimed (claim-once) handoff entry, so re-pin it first —
-            # the gateway's retry against another decode upstream then
-            # claims it instead of paying prefill again, exactly when
-            # the pool is saturated.
-            if kv_entry is not None:
+            def queue_full_429(message):
+                # one shape for every shed path (max_queue at submit AND the
+                # later queue_timeout sheds): the gateway's retry policy
+                # keys on the status + code. A shed request never used its
+                # claimed (claim-once) handoff entry, so re-pin it first —
+                # the gateway's retry against another decode upstream then
+                # claims it instead of paying prefill again, exactly when
+                # the pool is saturated.
+                if kv_entry is not None:
+                    try:
+                        store.publish(str(xfer["handoff_id"]), kv_entry)
+                    except Exception as e:  # noqa: BLE001 — the retry will
+                        # degrade to a local prefill; leave a trace of where
+                        # the entry went (silent loss is undebuggable)
+                        self.handoff_meter.repin_failed += 1
+                        from llm_in_practise_tpu.obs.logging import get_logger
+
+                        get_logger("serve.api").warning(
+                            "could not re-pin shed handoff entry %s (%s: "
+                            "%s); the retry will re-prefill",
+                            xfer["handoff_id"], type(e).__name__, e)
+                    else:
+                        self.handoff_meter.repinned += 1
+                span.end(status=429, finish_reason="queue_full")
+                return send_json(429, {"error": {
+                    "message": message + " — retry later or against "
+                               "another replica",
+                    "type": "rate_limit_error",
+                    "code": "queue_full",
+                }})
+
+            # admission control: a max_queue rejection is synchronous at
+            # submit — return 429 before any stream starts (vLLM/ingress
+            # backpressure parity; the gateway's retry policy keys on 429).
+            # A queue_timeout shed happens later and surfaces through the
+            # normal finish path below.
+            if handle.finish_reason == "queue_full":
+                return queue_full_429("engine queue full")
+
+            from llm_in_practise_tpu.serve.engine import _FINISH, EngineDeadError
+
+            def engine_dead_503():
+                span.end(status=503, finish_reason="engine_dead")
+                return send_json(503, {"error": {
+                    "message": "engine is not running — request cannot be "
+                               "served; retry against another replica",
+                    "type": "internal_error",
+                    "code": "engine_dead",
+                }})
+
+            if req.stream:
+                # hold the 200 until the request survives admission: a
+                # queue_timeout shed must surface as a retriable 429, not a
+                # silently empty SSE stream. Blocks until the first token
+                # (or finish) — exactly when the first data chunk could be
+                # sent anyway, so client-visible TTFT is unchanged. The
+                # wait is liveness-bounded (Request.next_item): a dead
+                # engine is a 503, not a client hanging with no headers.
                 try:
-                    store.publish(str(xfer["handoff_id"]), kv_entry)
-                except Exception as e:  # noqa: BLE001 — the retry will
-                    # degrade to a local prefill; leave a trace of where
-                    # the entry went (silent loss is undebuggable)
-                    self.handoff_meter.repin_failed += 1
-                    from llm_in_practise_tpu.obs.logging import get_logger
+                    first = handle.next_item()
+                except EngineDeadError:
+                    return engine_dead_503()
+                if first is _FINISH and handle.finish_reason == "queue_full":
+                    return queue_full_429("request timed out waiting for a slot")
 
-                    get_logger("serve.api").warning(
-                        "could not re-pin shed handoff entry %s (%s: "
-                        "%s); the retry will re-prefill",
-                        xfer["handoff_id"], type(e).__name__, e)
-                else:
-                    self.handoff_meter.repinned += 1
-            return send_json(429, {"error": {
-                "message": message + " — retry later or against "
-                           "another replica",
-                "type": "rate_limit_error",
-                "code": "queue_full",
-            }})
+                def chunks():
+                    # flush_s sums only the yield→resume gaps (the
+                    # consumer formatting + writing each SSE chunk) —
+                    # engine decode waits happen inside next_item() and
+                    # must NOT count, or this span would shadow
+                    # engine.decode in the per-phase breakdown
+                    flush_s = 0.0
+                    n_chunks = 0
+                    try:
+                        t = time.monotonic()
+                        yield schemas.chat_completion_chunk(
+                            req_id=req_id, model=req.model, delta=None
+                        )
+                        flush_s += time.monotonic() - t
+                        n_chunks += 1
+                        tokens, prev_text = [], ""
 
-        # admission control: a max_queue rejection is synchronous at
-        # submit — return 429 before any stream starts (vLLM/ingress
-        # backpressure parity; the gateway's retry policy keys on 429).
-        # A queue_timeout shed happens later and surfaces through the
-        # normal finish path below.
-        if handle.finish_reason == "queue_full":
-            return queue_full_429("engine queue full")
+                        def stream_toks():
+                            # mid-stream liveness: headers are out, so a dead
+                            # engine propagates EngineDeadError into _sse's
+                            # in-band error event instead of freezing the
+                            # stream
+                            tok = first
+                            while tok is not _FINISH:
+                                yield tok
+                                tok = handle.next_item()
+                        for tok in stream_toks():
+                            tokens.append(tok)
+                            text = self.tokenizer.decode(tokens)
+                            delta, prev_text = text[len(prev_text):], text
+                            if delta:
+                                t = time.monotonic()
+                                yield schemas.chat_completion_chunk(
+                                    req_id=req_id, model=req.model, delta=delta
+                                )
+                                flush_s += time.monotonic() - t
+                                n_chunks += 1
+                        t = time.monotonic()
+                        yield schemas.chat_completion_chunk(
+                            req_id=req_id, model=req.model, delta=None,
+                            finish_reason=handle.finish_reason or "stop",
+                        )
+                        flush_s += time.monotonic() - t
+                        n_chunks += 1
+                    finally:
+                        # SSE write loop = the stream-flush phase; its span
+                        # closes the trace's client-visible tail
+                        self.tracer.record(
+                            "api.stream_flush", span,
+                            duration_s=flush_s,
+                            chunks=n_chunks)
+                        # headers already went out as 200, but the span
+                        # must say how the stream actually ended: a mid-
+                        # flight engine death surfaces as an in-band
+                        # error event, a client disconnect as
+                        # GeneratorExit — neither is a clean "stop"
+                        exc = sys.exc_info()[1]
+                        if exc is None:
+                            span.end(status=200,
+                                     finish_reason=handle.finish_reason
+                                     or "stop")
+                        elif isinstance(exc, GeneratorExit):
+                            span.end(status=200,
+                                     finish_reason="client_disconnect",
+                                     chunks_sent=n_chunks)
+                        else:
+                            span.end(status=200,
+                                     finish_reason="stream_error",
+                                     error=type(exc).__name__,
+                                     chunks_sent=n_chunks)
+                return send_stream(chunks())
 
-        from llm_in_practise_tpu.serve.engine import _FINISH, EngineDeadError
-
-        def engine_dead_503():
-            return send_json(503, {"error": {
-                "message": "engine is not running — request cannot be "
-                           "served; retry against another replica",
-                "type": "internal_error",
-                "code": "engine_dead",
-            }})
-
-        if req.stream:
-            # hold the 200 until the request survives admission: a
-            # queue_timeout shed must surface as a retriable 429, not a
-            # silently empty SSE stream. Blocks until the first token
-            # (or finish) — exactly when the first data chunk could be
-            # sent anyway, so client-visible TTFT is unchanged. The
-            # wait is liveness-bounded (Request.next_item): a dead
-            # engine is a 503, not a client hanging with no headers.
             try:
-                first = handle.next_item()
+                out_ids = handle.result()
             except EngineDeadError:
                 return engine_dead_503()
-            if first is _FINISH and handle.finish_reason == "queue_full":
+            if handle.finish_reason == "queue_full":  # queue_timeout shed
                 return queue_full_429("request timed out waiting for a slot")
+            text = self.tokenizer.decode(out_ids)
+            usage = schemas.Usage(len(prompt_ids), len(out_ids))
+            span.end(status=200, finish_reason=handle.finish_reason or "stop",
+                     completion_tokens=len(out_ids))
+            return send_json(200, schemas.chat_completion_response(
+                req_id=req_id, model=req.model, text=text,
+                finish_reason=handle.finish_reason or "stop", usage=usage,
+            ))
+        except BaseException as e:
+            # a handler exception (kv upload on submit, tokenizer
+            # decode, ...) surfaces as do_POST's catch-all 500 — the
+            # span must record the failure, not leak unrecorded
+            span.end(status=500, finish_reason="error",
+                     error=type(e).__name__)
+            raise
 
-            def chunks():
-                yield schemas.chat_completion_chunk(
-                    req_id=req_id, model=req.model, delta=None
-                )
-                tokens, prev_text = [], ""
-
-                def stream_toks():
-                    # mid-stream liveness: headers are out, so a dead
-                    # engine propagates EngineDeadError into _sse's
-                    # in-band error event instead of freezing the stream
-                    tok = first
-                    while tok is not _FINISH:
-                        yield tok
-                        tok = handle.next_item()
-                for tok in stream_toks():
-                    tokens.append(tok)
-                    text = self.tokenizer.decode(tokens)
-                    delta, prev_text = text[len(prev_text):], text
-                    if delta:
-                        yield schemas.chat_completion_chunk(
-                            req_id=req_id, model=req.model, delta=delta
-                        )
-                yield schemas.chat_completion_chunk(
-                    req_id=req_id, model=req.model, delta=None,
-                    finish_reason=handle.finish_reason or "stop",
-                )
-            return send_stream(chunks())
-
-        try:
-            out_ids = handle.result()
-        except EngineDeadError:
-            return engine_dead_503()
-        if handle.finish_reason == "queue_full":  # queue_timeout shed
-            return queue_full_429("request timed out waiting for a slot")
-        text = self.tokenizer.decode(out_ids)
-        usage = schemas.Usage(len(prompt_ids), len(out_ids))
-        return send_json(200, schemas.chat_completion_response(
-            req_id=req_id, model=req.model, text=text,
-            finish_reason=handle.finish_reason or "stop", usage=usage,
-        ))
-
-    def metrics_text(self) -> str:
-        s = self.engine.stats
-        with s.lock:
-            ttft, tpot = list(s.ttft_s), list(s.tpot_s)
-            lines = [
-                "# TYPE llm_requests_total counter",
-                f"llm_requests_total {s.requests_total}",
-                "# TYPE llm_tokens_generated_total counter",
-                f"llm_tokens_generated_total {s.tokens_generated_total}",
-                "# TYPE llm_num_requests_waiting gauge",
-                f"llm_num_requests_waiting {s.queue_depth}",
-                "# TYPE llm_num_requests_running gauge",
-                f"llm_num_requests_running {s.active_slots}",
-                "# TYPE llm_requests_shed_total counter",
-                f"llm_requests_shed_total {s.requests_shed}",
-            ]
+    def _build_registry(self) -> Registry:
+        """Every family reads the live engine/meter counters at scrape
+        time — no double bookkeeping, one canonical renderer (TYPE
+        header per family, strict label escaping; pinned by the
+        exposition-parser tests)."""
+        reg = Registry()
+        eng = self.engine
+        s = eng.stats
+        reg.counter_func("llm_requests_total",
+                         lambda: s.requests_total,
+                         "requests submitted to the engine")
+        reg.counter_func("llm_tokens_generated_total",
+                         lambda: s.tokens_generated_total,
+                         "output tokens emitted")
+        reg.gauge_func("llm_num_requests_waiting", lambda: s.queue_depth,
+                       "requests queued for a slot")
+        reg.gauge_func("llm_num_requests_running", lambda: s.active_slots,
+                       "requests occupying slots")
+        reg.counter_func("llm_requests_shed_total",
+                         lambda: s.requests_shed,
+                         "requests shed by admission control")
         # dispatch accounting (docs/perf.md Findings 5/16/17): on a
         # dispatch-taxed host, dispatches/step IS the latency model —
         # the fused mixed step's win shows up here as ~1.0 under
         # simultaneous prefill+decode (it was 2 before)
-        dm = self.engine.dispatch_meter
-        lines += [
-            "# TYPE llm_dispatches_total counter",
-            f"llm_dispatches_total {dm.total}",
-            "# TYPE llm_dispatches_per_step gauge",
-            f"llm_dispatches_per_step {dm.mean_per_step:.3f}",
-            "# TYPE llm_mixed_blocks_total counter",
-            f"llm_mixed_blocks_total {self.engine.mixed_blocks}",
-        ]
+        dm = eng.dispatch_meter
+        reg.counter_func("llm_dispatches_total", lambda: dm.total,
+                         "jitted engine-program launches")
+        reg.gauge_func("llm_dispatches_per_step",
+                       lambda: dm.mean_per_step,
+                       "rolling mean dispatches per engine step")
+        reg.counter_func("llm_mixed_blocks_total",
+                         lambda: eng.mixed_blocks,
+                         "fused prefill+decode dispatches")
         # per-role latency labels (disaggregated serving): a prefill
         # replica's "TTFT" is KV-ready time, a decode replica's TPOT is
         # the interference-free number the split exists for. Plain
         # (unlabeled) series are kept for role=both so existing
-        # dashboards/scrapes see the same names.
-        role_label = "" if self.role == "both" else f'role="{self.role}",'
-        # _count/_sum must carry the SAME parent label set as the
-        # quantile series (Prometheus summary convention) or per-role
-        # rate()/avg queries silently return nothing
-        bare_label = "" if self.role == "both" else f'{{role="{self.role}"}}'
-        for name, vals in (("llm_ttft_seconds", ttft), ("llm_tpot_seconds", tpot)):
-            lines += [
-                f"# TYPE {name} summary",
-                f'{name}{{{role_label}quantile="0.5"}} '
-                f"{_quantile(vals, 0.5):.6f}",
-                f'{name}{{{role_label}quantile="0.99"}} '
-                f"{_quantile(vals, 0.99):.6f}",
-                f"{name}_count{bare_label} {len(vals)}",
-                f"{name}_sum{bare_label} {sum(vals):.6f}",
-            ]
+        # dashboards/scrapes see the same names. Bucketed histograms
+        # (was: full-history summaries) — PromQL quantiles come from
+        # histogram_quantile() over the _bucket series.
+        role_labels = {} if self.role == "both" else {"role": self.role}
+        reg.histogram_func("llm_ttft_seconds",
+                           lambda: [(role_labels, s.ttft)],
+                           "time to first token (prefill replicas: "
+                           "KV-claimable time)")
+        reg.histogram_func("llm_tpot_seconds",
+                           lambda: [(role_labels, s.tpot)],
+                           "mean time per output token after the first")
         # disaggregation accounting: published/claimed say the handoff
         # plane works; lost + local re-prefills say how often the decode
         # pool fell back to doing prefill itself (the llm-d health signal)
-        eng = self.engine
         hm = self.handoff_meter
-        if (self.role != "both" or eng.handoff is not None
-                or hm.claimed or hm.lost):
-            lines += [
-                "# TYPE llm_handoff_total counter",
-                f'llm_handoff_total{{event="published"}} '
-                f"{eng.handoff_published}",
-                f'llm_handoff_total{{event="publish_failed"}} '
-                f"{eng.handoff_publish_failed}",
-                f'llm_handoff_total{{event="claimed"}} {hm.claimed}',
-                f'llm_handoff_total{{event="kv_admitted"}} '
-                f"{eng.kv_admitted}",
-                f'llm_handoff_total{{event="kv_rejected"}} '
-                f"{eng.kv_rejected}",
-                f'llm_handoff_total{{event="repinned"}} {hm.repinned}',
-                f'llm_handoff_total{{event="repin_failed"}} '
-                f"{hm.repin_failed}",
-                "# TYPE llm_handoff_lost_total counter",
-                f"llm_handoff_lost_total {hm.lost}",
-                "# TYPE llm_local_prefills_total counter",
-                f"llm_local_prefills_total {eng.local_prefills}",
-            ]
-        pc = self.engine.prefix_cache
-        if pc is not None:
-            lines += [
-                "# TYPE llm_prefix_cache_hits_total counter",
-                f"llm_prefix_cache_hits_total {pc.hits}",
-                "# TYPE llm_prefix_cache_full_hits_total counter",
-                f"llm_prefix_cache_full_hits_total {pc.full_hits}",
-                "# TYPE llm_prefix_cache_misses_total counter",
-                f"llm_prefix_cache_misses_total {pc.misses}",
-                "# TYPE llm_prefix_cache_tokens_saved_total counter",
-                f"llm_prefix_cache_tokens_saved_total {pc.tokens_saved}",
-                "# TYPE llm_prefix_cache_tokens gauge",
-                f"llm_prefix_cache_tokens {pc.cached_tokens}",
-            ]
-        if self.engine.speculative_k is not None:
-            lines += [
-                "# TYPE llm_spec_tokens_proposed_total counter",
-                f"llm_spec_tokens_proposed_total {self.engine.spec_proposed}",
-                "# TYPE llm_spec_tokens_accepted_total counter",
-                f"llm_spec_tokens_accepted_total {self.engine.spec_accepted}",
-            ]
-        if getattr(self.engine, "decode_steps", 1) > 1:
+        reg.counter_func(
+            "llm_handoff_total",
+            lambda: [({"event": "published"}, eng.handoff_published),
+                     ({"event": "publish_failed"},
+                      eng.handoff_publish_failed),
+                     ({"event": "claimed"}, hm.claimed),
+                     ({"event": "kv_admitted"}, eng.kv_admitted),
+                     ({"event": "kv_rejected"}, eng.kv_rejected),
+                     ({"event": "repinned"}, hm.repinned),
+                     ({"event": "repin_failed"}, hm.repin_failed)],
+            "disaggregated KV handoff events")
+        reg.counter_func("llm_handoff_lost_total", lambda: hm.lost,
+                         "handoff ids that resolved to no entry")
+        reg.counter_func("llm_local_prefills_total",
+                         lambda: eng.local_prefills,
+                         "prefills a decode-role replica ran itself")
+        # read eng.prefix_cache LIVE at scrape time: benches and serving
+        # setups attach/replace the cache after server construction
+        # (e.g. tools/tpu_serve_qwen3_bench.py), and the pre-registry
+        # exposition tracked that; no cache → family present, no samples
+        def _pc(attr):
+            def read():
+                pc = eng.prefix_cache
+                return [] if pc is None else [({}, getattr(pc, attr))]
+            return read
+
+        reg.counter_func("llm_prefix_cache_hits_total", _pc("hits"))
+        reg.counter_func("llm_prefix_cache_full_hits_total",
+                         _pc("full_hits"))
+        reg.counter_func("llm_prefix_cache_misses_total", _pc("misses"))
+        reg.counter_func("llm_prefix_cache_tokens_saved_total",
+                         _pc("tokens_saved"))
+        reg.gauge_func("llm_prefix_cache_tokens", _pc("cached_tokens"))
+        if eng.speculative_k is not None:
+            reg.counter_func("llm_spec_tokens_proposed_total",
+                             lambda: eng.spec_proposed)
+            reg.counter_func("llm_spec_tokens_accepted_total",
+                             lambda: eng.spec_accepted)
+        if getattr(eng, "decode_steps", 1) > 1:
             # operators tuning --decode-steps need to see whether blocks
             # actually run (the gate silently falls back to single-step)
-            lines += [
-                "# TYPE llm_multi_decode_blocks_total counter",
-                f"llm_multi_decode_blocks_total {self.engine.multi_blocks}",
-            ]
-        return "\n".join(lines) + "\n"
+            reg.counter_func("llm_multi_decode_blocks_total",
+                             lambda: eng.multi_blocks)
+        return reg
+
+    def metrics_text(self) -> str:
+        return self.registry.render()
 
     # --- HTTP plumbing -------------------------------------------------------
 
@@ -508,8 +608,9 @@ class OpenAIServer:
                     pass  # client went away mid-stream
 
             def do_GET(self):
-                if self.path == "/health":
-                    return self._json(200, {"status": "ok"})
+                if serve_obs_get(self, server.metrics_text,
+                                 server.tracer):
+                    return
                 if self.path == "/v1/models":
                     return self._json(200, {
                         "object": "list",
@@ -524,9 +625,6 @@ class OpenAIServer:
                         200, webui_html(server.model_name).encode(),
                         "text/html; charset=utf-8",
                     )
-                if self.path == "/metrics":
-                    return self._text(200, server.metrics_text().encode(),
-                                      "text/plain; version=0.0.4")
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):
@@ -537,12 +635,18 @@ class OpenAIServer:
                 body, err = self._read_json()
                 if err:
                     return self._json(400, err)
+                # cross-hop trace continuity: the gateway (or any
+                # client) propagates a traceparent header; spans minted
+                # here join that trace instead of starting a new one
+                ctx = parse_traceparent(self.headers.get("traceparent"))
                 try:
                     if self.path == "/v1/embeddings":
                         return server.handle_embeddings(body, self._json)
                     if self.path == "/internal/handoff/prefill":
-                        return server.handle_prefill(body, self._json)
-                    return server.handle_chat(body, self._json, self._sse)
+                        return server.handle_prefill(body, self._json,
+                                                     trace=ctx)
+                    return server.handle_chat(body, self._json, self._sse,
+                                              trace=ctx)
                 except Exception as e:  # noqa: BLE001 — a handler fault must
                     # still answer the client, not drop the connection. If a
                     # response already went out (SSE underway), sending a

@@ -39,6 +39,7 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from llm_in_practise_tpu.obs.registry import Registry
 from llm_in_practise_tpu.serve.gateway import ResponseCache
 
 
@@ -91,6 +92,7 @@ class CacheService:
             ttl_s=ttl_s, max_entries=max_entries,
             semantic_threshold=semantic_threshold, embed_fn=embed_fn)
         self._httpd: ThreadingHTTPServer | None = None
+        self.registry = self._build_registry()
 
     # -- request handling -----------------------------------------------------
 
@@ -100,6 +102,13 @@ class CacheService:
             return 200, {"status": "ok"}
         if method == "GET" and path == "/metrics":
             return 200, {"text": self.metrics_text()}
+        if method == "GET" and path == "/debug/traces":
+            # every server in the stack serves the process trace ring
+            # (docs/observability.md) — populated here whenever any
+            # span-recording component is colocated in this process
+            from llm_in_practise_tpu.obs.trace import get_tracer
+
+            return 200, get_tracer().debug_payload()
         if method == "POST" and path == "/cache/get":
             if not isinstance(body, dict):
                 return 422, {"error": "body must be the chat request"}
@@ -115,17 +124,28 @@ class CacheService:
             return 200, {"ok": True}
         return 404, {"error": f"no route {method} {path}"}
 
-    def metrics_text(self) -> str:
+    def _build_registry(self) -> Registry:
+        """Unified-registry exposition (obs/registry.py). Every family
+        now gets a ``# TYPE`` header — the hand-rolled block emitted
+        bare samples, which strict Prometheus parsers reject (the bug
+        the migration subsumes; pinned by the exposition tests)."""
         c = self.cache
-        lines = [
-            ("llm_cache_exact_hits_total", c.hits),
-            ("llm_cache_semantic_hits_total", c.semantic_hits),
-            ("llm_cache_misses_total", c.misses),
-            ("llm_cache_entries", len(c._exact)),
-            ("llm_cache_semantic_entries", len(c._semantic)),
-            ("llm_cache_embed_fallbacks_total", self._embed_failures["n"]),
-        ]
-        return "".join(f"{k} {v}\n" for k, v in lines)
+        reg = Registry()
+        reg.counter_func("llm_cache_exact_hits_total", lambda: c.hits)
+        reg.counter_func("llm_cache_semantic_hits_total",
+                         lambda: c.semantic_hits)
+        reg.counter_func("llm_cache_misses_total", lambda: c.misses)
+        reg.gauge_func("llm_cache_entries", lambda: len(c._exact))
+        reg.gauge_func("llm_cache_semantic_entries",
+                       lambda: len(c._semantic))
+        reg.counter_func("llm_cache_embed_fallbacks_total",
+                         lambda: self._embed_failures["n"],
+                         "semantic lookups that fell back to hashed-BoW "
+                         "after an embedding-service fault")
+        return reg
+
+    def metrics_text(self) -> str:
+        return self.registry.render()
 
     # -- HTTP plumbing --------------------------------------------------------
 

@@ -30,6 +30,14 @@ This module holds the handoff stores the roles speak through:
 :class:`LocalHandoff` (in-process — tests, single-host multi-engine) and
 :class:`RemoteHandoff` (the shared :class:`~.kv_pool.KVPoolServer`).
 Both expose ``publish``/``claim`` with the same lost-entry semantics.
+
+Observability (docs/observability.md): the gateway's two-phase dispatch
+rides the request's trace id through ``kv_transfer_params`` — alongside
+``handoff_id`` and ``model`` it carries ``trace`` (a traceparent-format
+string), so the decode replica's ``handoff.claim`` span joins the same
+trace as the prefill replica's ``handoff.publish`` span even when an
+intermediary strips HTTP headers. The pool server's handoff counters
+(pins/claims/TTL-reclaims/bytes) export at its ``--metrics-port``.
 """
 
 from __future__ import annotations

@@ -46,6 +46,8 @@ from llm_in_practise_tpu.infer.generate import max_positions
 from llm_in_practise_tpu.infer.sampling import sample_token_batched
 from llm_in_practise_tpu.obs.logging import get_logger
 from llm_in_practise_tpu.obs.meter import DispatchMeter
+from llm_in_practise_tpu.obs.registry import HistogramAccumulator
+from llm_in_practise_tpu.obs.trace import get_tracer
 from llm_in_practise_tpu.serve.mixed_step import (
     batched_chunk,
     decode_scan,
@@ -99,6 +101,14 @@ class Request:
     kv_entry: object | None = dataclasses.field(
         default=None, repr=False, compare=False)
     handoff_id: str | None = None
+    # request tracing (obs/trace.py): the TraceContext the API layer
+    # minted for this request — the engine parents its queue-wait /
+    # admission / prefill-chunk / decode / handoff-publish spans here,
+    # so one trace id covers the request across every hop. ``None``
+    # (untraced submit paths: benches, direct engine use) records
+    # nothing.
+    trace: object | None = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def next_item(self, poll_s: float = 1.0):
         """Next queue item — a token id or the internal finish sentinel
@@ -142,14 +152,20 @@ class Request:
 
 
 class EngineStats:
-    """Counters/histograms surfaced at /metrics (SURVEY §5.5 PromQL table)."""
+    """Counters/histograms surfaced at /metrics (SURVEY §5.5 PromQL table).
+
+    TTFT/TPOT are fixed-bucket :class:`HistogramAccumulator`s — O(1)
+    memory however long the server runs. (They were plain lists growing
+    one float per request forever; a week of sustained load leaked the
+    whole latency history into RAM just to answer a quantile query.)
+    """
 
     def __init__(self):
         self.lock = threading.Lock()
         self.requests_total = 0
         self.tokens_generated_total = 0
-        self.ttft_s: list[float] = []
-        self.tpot_s: list[float] = []
+        self.ttft = HistogramAccumulator()
+        self.tpot = HistogramAccumulator()
         self.queue_depth = 0
         self.active_slots = 0
         self.requests_shed = 0
@@ -157,10 +173,13 @@ class EngineStats:
     def observe_finished(self, req: Request):
         with self.lock:
             self.tokens_generated_total += req.n_generated
-            if req.ttft_s is not None:
-                self.ttft_s.append(req.ttft_s)
-            if req.tpot_s is not None:
-                self.tpot_s.append(req.tpot_s)
+        # the accumulators carry their own locks — keep the observe
+        # outside stats.lock so a scrape-time snapshot never serializes
+        # against the engine thread's finish path
+        if req.ttft_s is not None:
+            self.ttft.observe(req.ttft_s)
+        if req.tpot_s is not None:
+            self.tpot.observe(req.tpot_s)
 
 
 def _default_buckets(cache_len: int) -> tuple[int, ...]:
@@ -205,6 +224,7 @@ class InferenceEngine:
         draft_params=None,
         role: str = "both",
         handoff=None,
+        tracer=None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -408,6 +428,10 @@ class InferenceEngine:
         self.mixed_step = bool(mixed_step)
         self.mixed_blocks = 0
         self._log = get_logger("serve.engine")
+        # request tracing (obs/trace.py): spans parent to each request's
+        # TraceContext; the process default keeps a single-process stack
+        # (tests, chip sharing) on one correlated trace plane
+        self.tracer = tracer if tracer is not None else get_tracer()
         self._spec_suspended_logged = False
         self._mixed_fallbacks_logged: set[str] = set()
         # Guaranteed chunked-prefill budget: every engine step runs up to
@@ -900,19 +924,22 @@ class InferenceEngine:
         return req
 
     def submit(self, prompt_ids, params: SamplingParams | None = None, *,
-               kv_entry=None, handoff_id: str | None = None) -> Request:
+               kv_entry=None, handoff_id: str | None = None,
+               trace=None) -> Request:
         """``kv_entry`` (optional): a :class:`~.kv_pool.HostEntry` claimed
         from a handoff store — validated and uploaded HERE, on the
         caller's (HTTP) thread, so the engine loop admits it as a pure
         direct insert. ``handoff_id`` (optional): prefill-only request —
-        publish the prompt KV under this id instead of decoding."""
+        publish the prompt KV under this id instead of decoding.
+        ``trace`` (optional): a :class:`~..obs.trace.TraceContext` the
+        engine parents this request's phase spans to."""
         params = params or SamplingParams()
         prompt_ids = list(map(int, prompt_ids))
         max_prompt = self.cache_len - 2
         if len(prompt_ids) > max_prompt:  # sliding-window crop (reference
             prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
         req = Request(next(self._uid), prompt_ids, params, engine=self,
-                      handoff_id=handoff_id)
+                      handoff_id=handoff_id, trace=trace)
         # the upload must land on the request BEFORE it is queued — the
         # engine thread may admit it the instant the put releases
         if kv_entry is not None:
@@ -940,6 +967,15 @@ class InferenceEngine:
             if n <= b:
                 return b
         return self.cache_len
+
+    def _trace_phase(self, req: Request, name: str, duration_s: float,
+                     **attrs) -> None:
+        """Record one engine phase span for a traced request. Untraced
+        requests (direct engine use, benches) cost one ``is None``."""
+        if req.trace is None:
+            return
+        self.tracer.record(name, req.trace, duration_s=duration_s,
+                           uid=req.uid, **attrs)
 
     def _admit(self) -> bool:
         """Move pending requests into free slots. Plain one-shot prefills
@@ -988,6 +1024,11 @@ class InferenceEngine:
                     req = None
             if req is None:
                 break
+            # queue wait = submit → a slot freed for it; under sustained
+            # load this span is where a request's time actually goes
+            self._trace_phase(req, "engine.queue_wait",
+                              time.monotonic() - req.submit_time,
+                              slot=slot)
             plen = len(req.prompt_ids)
             hit = self._lookup_prefix(req, plen)
             if (self.role == "decode"
@@ -1022,12 +1063,29 @@ class InferenceEngine:
                         seen.add(tuple(req.prompt_ids))
                     batch.append((slot, req, plen))
             else:
+                t0 = time.monotonic()
+                path = ("kv_direct_insert"
+                        if hit is not None and hit.length == plen
+                        else "prefill")
                 self._begin_prefill(req, slot, plen, hit=hit)
+                self._trace_phase(req, "engine.admit",
+                                  time.monotonic() - t0, slot=slot,
+                                  path=path, prompt_tokens=plen)
             admitted = True
         if batch:
+            t0 = time.monotonic()
             self._prefill_batch(batch)
+            dt = time.monotonic() - t0
+            for slot, req, plen in batch:
+                self._trace_phase(req, "engine.admit", dt, slot=slot,
+                                  path="oneshot_batch", prompt_tokens=plen,
+                                  batched=len(batch))
         for slot, req, plen in deferred:
+            t0 = time.monotonic()
             self._begin_prefill(req, slot, plen)  # fresh lookup: now a hit
+            self._trace_phase(req, "engine.admit", time.monotonic() - t0,
+                              slot=slot, path="deferred_prefix_hit",
+                              prompt_tokens=plen)
         with self.stats.lock:
             self.stats.queue_depth = self.pending.qsize()
             self.stats.active_slots = sum(r is not None for r in self.slot_req)
@@ -1137,6 +1195,7 @@ class InferenceEngine:
 
         while True:
             req, plen, entry = self._publish_queue.get()
+            t0 = time.monotonic()
             try:
                 if self.handoff is None:
                     raise RuntimeError("engine has no handoff store")
@@ -1153,6 +1212,14 @@ class InferenceEngine:
                 with self._publish_lock:
                     self.handoff_published += 1
                 req.finish_reason = "handoff"
+            # device→host copy + store put — the KV-transfer cost the
+            # disaggregation trade pays; its span is how a dashboard
+            # shows handoff overhead per request
+            self._trace_phase(req, "handoff.publish",
+                              time.monotonic() - t0,
+                              handoff_id=req.handoff_id,
+                              prompt_tokens=plen,
+                              ok=req.finish_reason == "handoff")
             req.finish_time = time.monotonic()
             # KV-claimable time is this request's TTFT analog: per-role
             # llm_ttft_seconds on a prefill replica = prefill service
@@ -1346,6 +1413,7 @@ class InferenceEngine:
                 if s not in self.slot_prefill
                 and self.slot_req[s] is not None  # free rows are dead
             )
+            t0 = time.monotonic()
             if batchable:
                 tok, starts, lens = self._chunk_batch_rows(entries)
                 last, self.cache = self._chunk_batch(
@@ -1365,10 +1433,23 @@ class InferenceEngine:
                         jnp.asarray(len(chunk), jnp.int32),
                     )
                     st["done"] += len(chunk)
+            self._trace_chunks(entries, time.monotonic() - t0,
+                               batched=batchable)
             budget -= 1
             progressed = True
             self._finalize_prefills()
         return progressed
+
+    def _trace_chunks(self, entries, dt: float, *, batched: bool,
+                      fused: bool = False) -> None:
+        """One ``engine.prefill_chunk`` span per traced mid-prefill row
+        (the duration is dispatch-issue time — on an async backend the
+        device compute may still be in flight, see docs/observability.md)."""
+        for slot, st, chunk in entries:
+            self._trace_phase(st["req"], "engine.prefill_chunk", dt,
+                              slot=slot, done=st["done"],
+                              chunk_tokens=len(chunk), batched=batched,
+                              fused=fused)
 
     def _chunk_batch_rows(self, entries):
         """Host arrays (tok, starts, lens) for a whole-cache batched
@@ -1488,6 +1569,15 @@ class InferenceEngine:
             req.finish_reason = (
                 "stop" if hit_eos else ("length" if not budget_left else "cache")
             )
+            if req.first_token_time is not None:
+                # the decode phase: first token → finish (TPOT × tokens).
+                # Recorded BEFORE _FINISH is released: a consumer that
+                # saw the stream end must find the span in the ring.
+                self._trace_phase(
+                    req, "engine.decode",
+                    req.finish_time - req.first_token_time,
+                    slot=slot, tokens=req.n_generated,
+                    finish_reason=req.finish_reason)
             req.tokens.put(_FINISH)
             self.stats.observe_finished(req)
             self.slot_req[slot] = None
@@ -1681,6 +1771,7 @@ class InferenceEngine:
         tok, starts, lens = self._chunk_batch_rows(entries)
         advance = np.zeros((self.max_slots,), np.int32)
         advance[active] = n
+        t0 = time.monotonic()
         self.rng, sub = jax.random.split(self.rng)
         chunk_last, toks, self.cache = self._mixed(
             self.params, self.cache, jnp.asarray(tok),
@@ -1696,6 +1787,8 @@ class InferenceEngine:
         for slot, st, chunk in entries:
             st["last_logits"] = chunk_last[slot:slot + 1]
             st["done"] += len(chunk)
+        self._trace_chunks(entries, time.monotonic() - t0,
+                           batched=True, fused=True)
         self._finalize_prefills()
         self._commit_block(active, np.asarray(toks), n)
 

@@ -36,7 +36,13 @@ import urllib.request
 from dataclasses import dataclass, field
 from http.server import ThreadingHTTPServer
 
-from llm_in_practise_tpu.serve.http_util import JsonHandler
+from llm_in_practise_tpu.obs.registry import Registry
+from llm_in_practise_tpu.obs.trace import (
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
+from llm_in_practise_tpu.serve.http_util import JsonHandler, serve_obs_get
 
 
 @dataclass
@@ -462,6 +468,7 @@ class Gateway:
         moderation=None,
         timeout_s: float = 120.0,
         health_check_interval_s: float = 30.0,
+        tracer=None,
     ):
         self.router = router
         self.retry_policy = retry_policy
@@ -481,22 +488,35 @@ class Gateway:
         self._httpd: ThreadingHTTPServer | None = None
         self._health_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # request tracing: the gateway mints the root span of every
+        # request's trace and propagates it to the upstreams via a
+        # traceparent header (and through kv_transfer_params for the
+        # prefill→decode hop) — obs/trace.py, docs/observability.md
+        self.tracer = tracer if tracer is not None else get_tracer()
+        # unified metrics registry: one canonical exposition renderer
+        # over the live router/cache counters (obs/registry.py). Built
+        # LAST — the callbacks close over attributes set above.
+        self.registry = self._build_registry()
 
     # --- upstream I/O --------------------------------------------------------
 
     def _forward(self, upstream: Upstream, body: dict,
-                 stream: bool = False) -> tuple[int, object]:
+                 stream: bool = False, trace=None) -> tuple[int, object]:
         """POST to one upstream. Non-stream: (status, parsed-JSON dict).
         Stream success: (200, stream handle) — the caller relays the SSE
         bytes and closes it; ``pending`` is held until that close, so the
         replica counts as busy for the stream's whole lifetime (the
         autoscaler's drain check and least-pending routing both rely on
-        this)."""
+        this). ``trace``: the request's TraceContext, propagated as a
+        traceparent header so the replica's spans join the trace."""
         payload = dict(body, model=upstream.model)
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            headers["traceparent"] = format_traceparent(trace)
         req = urllib.request.Request(
             f"{upstream.base_url}/v1/chat/completions",
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         with upstream.lock:
             upstream.pending += 1
@@ -527,19 +547,32 @@ class Gateway:
                 with upstream.lock:
                     upstream.pending -= 1
 
-    def _disagg_prefill(self, group: str, body: dict) -> dict:
+    def _disagg_prefill(self, group: str, body: dict,
+                        parent=None) -> dict:
         """Phase one of disaggregated dispatch: have a prefill-pool
         replica compute and pin the prompt KV, and return the body the
         decode-pool forward should carry (``kv_transfer_params``). Any
         failure degrades to the plain single-phase path — the body comes
         back unchanged and whichever upstream serves it prefills
-        locally (the decode replica counts that)."""
+        locally (the decode replica counts that). ``parent``: the
+        request's root span — the prefill phase records under it and
+        the handoff body carries the trace id to the decode replica."""
         pick_prefill = getattr(self.router, "pick_prefill", None)
         if pick_prefill is None:
             return body
         upstream = pick_prefill(group)
         if upstream is None:
             return body
+        span = self.tracer.start_span("gateway.prefill_phase",
+                                      parent=parent,
+                                      upstream=upstream.base_url)
+        try:
+            return self._disagg_prefill_call(group, body, upstream, span)
+        finally:
+            span.end()
+
+    def _disagg_prefill_call(self, group: str, body: dict,
+                             upstream: Upstream, span) -> dict:
         # the handoff namespace is the MODEL name: a prefill upstream
         # publishing as m1 can never be claimed by a decode upstream
         # serving m2 — every handoff would silently expire as 'lost'
@@ -560,11 +593,15 @@ class Gateway:
                     group, upstream.model, sorted(dec_models))
             self.handoff_failed_total += 1
             return body
+        ctx = span.context()
+        headers = {"Content-Type": "application/json"}
+        if ctx is not None:
+            headers["traceparent"] = format_traceparent(ctx)
         req = urllib.request.Request(
             f"{upstream.base_url}/internal/handoff/prefill",
             data=json.dumps({"messages": body.get("messages", []),
                              "model": upstream.model}).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         # the prefill call occupies the replica exactly like a
         # completion does — least-pending over the prefill pool needs it
@@ -595,10 +632,16 @@ class Gateway:
                 upstream.pending -= 1
         upstream.record_success()
         self.handoff_total += 1
+        span.set(handoff_id=hid, ok=True)
         # the model rides along: the handoff namespace IS the model
-        # name, so the decode pick must prefer replicas serving it
-        return dict(body, kv_transfer_params={"handoff_id": hid,
-                                              "model": upstream.model})
+        # name, so the decode pick must prefer replicas serving it —
+        # and the trace id rides with it, so the decode replica's claim
+        # span joins this request's trace even if an intermediary
+        # strips the traceparent header
+        xfer = {"handoff_id": hid, "model": upstream.model}
+        if ctx is not None:
+            xfer["trace"] = format_traceparent(ctx)
+        return dict(body, kv_transfer_params=xfer)
 
     def _estimate_tokens(self, body: dict) -> int:
         chars = sum(len(str(m.get("content", "")))
@@ -611,11 +654,28 @@ class Gateway:
         chain += [g for g in self.fallbacks.get(group, []) if g not in chain]
         return chain
 
-    def handle_completion(self, body: dict,
-                          stream: bool = False) -> tuple[int, object]:
+    def handle_completion(self, body: dict, stream: bool = False,
+                          trace=None) -> tuple[int, object]:
         """Route one completion. ``stream=True`` returns ``(200, open http
         response)`` on success (relay its bytes); errors are (status, dict)
-        either way. The cache only serves non-stream requests."""
+        either way. The cache only serves non-stream requests.
+        ``trace``: an incoming TraceContext (from a client traceparent
+        header); ``None`` starts a fresh trace rooted here."""
+        span = self.tracer.start_span(
+            "gateway.route", parent=trace,
+            model=body.get("model"), stream=bool(stream))
+        try:
+            status, resp = self._route(body, stream, span)
+            span.set(status=status)
+            return status, resp
+        finally:
+            # streaming success: the span closes at headers-received —
+            # the stream's lifetime belongs to the replica's api.chat
+            # span; this one is the routing decision + connect
+            span.end()
+
+    def _route(self, body: dict, stream: bool,
+               span) -> tuple[int, object]:
         self.requests_total += 1
         group = body.get("model") or (self.router.groups() or ["default"])[0]
 
@@ -632,7 +692,10 @@ class Gateway:
                     }}
 
         if self.cache is not None and not stream:
-            cached = self.cache.get(body)
+            with self.tracer.span("gateway.cache_lookup",
+                                  parent=span) as cs:
+                cached = self.cache.get(body)
+                cs.set(hit=cached is not None)
             if cached is not None:
                 resp = dict(cached)
                 resp["cached"] = True
@@ -652,7 +715,7 @@ class Gateway:
         # at the prefill pool first; the forwarded body then carries the
         # handoff id. Only the primary group gets it — a fallback group
         # is a different model whose KV namespace cannot use this entry.
-        handoff_body = (self._disagg_prefill(group, body)
+        handoff_body = (self._disagg_prefill(group, body, parent=span)
                         if chain and chain[0] == group else body)
 
         last_status, last_detail = 502, {"error": {"message": "no upstream"}}
@@ -672,7 +735,8 @@ class Gateway:
                 attempts = 0
                 while True:
                     status, resp = self._forward(upstream, g_body,
-                                                 stream=stream)
+                                                 stream=stream,
+                                                 trace=span.context())
                     if status == 200:
                         upstream.record_success()
                         if stream:
@@ -720,74 +784,85 @@ class Gateway:
 
     # --- HTTP ----------------------------------------------------------------
 
-    def metrics_text(self) -> str:
-        lines = [
-            "# TYPE gateway_requests_total counter",
-            f"gateway_requests_total {self.requests_total}",
-            "# TYPE gateway_upstream_failures_total counter",
-            f"gateway_upstream_failures_total {self.failures_total}",
-            "# TYPE gateway_fallbacks_total counter",
-            f"gateway_fallbacks_total {self.fallbacks_total}",
-        ]
+    def _build_registry(self) -> Registry:
+        """Scrape-time families over the live gateway/router/cache
+        counters. The per-upstream series now carry ``# TYPE`` headers
+        (they were emitted bare, which strict Prometheus parsers reject
+        — the bug the registry migration subsumes and the exposition
+        tests pin); the label set/order is unchanged so existing
+        dashboards keep matching."""
+        reg = Registry()
+        reg.counter_func("gateway_requests_total",
+                         lambda: self.requests_total,
+                         "completions routed")
+        reg.counter_func("gateway_upstream_failures_total",
+                         lambda: self.failures_total,
+                         "retriable upstream failures observed")
+        reg.counter_func("gateway_fallbacks_total",
+                         lambda: self.fallbacks_total,
+                         "fallback-chain hops taken")
         if self.cache is not None:
-            lines += [
-                "# TYPE gateway_cache_hits_total counter",
-                f"gateway_cache_hits_total {self.cache.hits}",
-                "# TYPE gateway_cache_semantic_hits_total counter",
-                f"gateway_cache_semantic_hits_total {self.cache.semantic_hits}",
-                "# TYPE gateway_cache_misses_total counter",
-                f"gateway_cache_misses_total {self.cache.misses}",
-            ]
-            # remote caches additionally track lookups that never reached
-            # the service (cooldown/transport) — without this line an
-            # outage reads as zero cache traffic instead of degraded
-            skipped = getattr(self.cache, "skipped", None)
-            if skipped is not None:
-                lines += [
-                    "# TYPE gateway_cache_skipped_total counter",
-                    f"gateway_cache_skipped_total {skipped}",
-                ]
-        if self.handoff_total or self.handoff_failed_total or hasattr(
-                self.router, "pick_prefill"):
-            degraded = getattr(self.router, "degraded_picks", 0)
-            lines += [
-                "# TYPE gateway_handoff_total counter",
-                f"gateway_handoff_total {self.handoff_total}",
-                "# TYPE gateway_handoff_failed_total counter",
-                f"gateway_handoff_failed_total {self.handoff_failed_total}",
-                "# TYPE gateway_disagg_degraded_total counter",
-                f"gateway_disagg_degraded_total {degraded}",
-            ]
-        now = time.time()
-        for u in self.router.upstreams:
-            label = (f'{{group="{u.group}",url="{u.base_url}"'
-                     f',role="{u.role}"}}')
-            lines += [
-                f"gateway_upstream_pending{label} {u.pending}",
-                f"gateway_upstream_available{label} {int(u.available(now))}",
-                f"gateway_upstream_picks_total{label} {u.picks}",
-                f"gateway_upstream_cooldowns_total{label} {u.cooldowns}",
-                f"gateway_upstream_affinity_hits_total{label} "
-                f"{u.affinity_hits}",
-            ]
-        return "\n".join(lines) + "\n"
+            cache = self.cache
+            reg.counter_func("gateway_cache_hits_total",
+                             lambda: cache.hits)
+            reg.counter_func("gateway_cache_semantic_hits_total",
+                             lambda: cache.semantic_hits)
+            reg.counter_func("gateway_cache_misses_total",
+                             lambda: cache.misses)
+            # remote caches additionally track lookups that never
+            # reached the service (cooldown/transport) — without this
+            # series an outage reads as zero cache traffic instead of
+            # degraded
+            if hasattr(cache, "skipped"):
+                reg.counter_func("gateway_cache_skipped_total",
+                                 lambda: cache.skipped)
+        reg.counter_func("gateway_handoff_total",
+                         lambda: self.handoff_total,
+                         "prefill phases that published KV")
+        reg.counter_func("gateway_handoff_failed_total",
+                         lambda: self.handoff_failed_total,
+                         "prefill phases that errored (degraded)")
+        reg.counter_func(
+            "gateway_disagg_degraded_total",
+            lambda: getattr(self.router, "degraded_picks", 0),
+            "picks served outside the role split")
+
+        def per_upstream(value_of):
+            def collect():
+                return [({"group": u.group, "url": u.base_url,
+                          "role": u.role}, value_of(u))
+                        for u in self.router.upstreams]
+            return collect
+
+        reg.gauge_func("gateway_upstream_pending",
+                       per_upstream(lambda u: u.pending))
+        reg.gauge_func(
+            "gateway_upstream_available",
+            per_upstream(lambda u: int(u.available(time.time()))))
+        reg.counter_func("gateway_upstream_picks_total",
+                         per_upstream(lambda u: u.picks))
+        reg.counter_func("gateway_upstream_cooldowns_total",
+                         per_upstream(lambda u: u.cooldowns))
+        reg.counter_func("gateway_upstream_affinity_hits_total",
+                         per_upstream(lambda u: u.affinity_hits))
+        return reg
+
+    def metrics_text(self) -> str:
+        return self.registry.render()
 
     def make_handler(self):
         gw = self
 
         class Handler(JsonHandler):
             def do_GET(self):
-                if self.path == "/health":
-                    return self._json(200, {"status": "ok"})
+                if serve_obs_get(self, gw.metrics_text, gw.tracer):
+                    return
                 if self.path == "/v1/models":
                     return self._json(200, {
                         "object": "list",
                         "data": [{"id": g, "object": "model"}
                                  for g in gw.router.groups()],
                     })
-                if self.path == "/metrics":
-                    return self._text(200, gw.metrics_text().encode(),
-                                      "text/plain; version=0.0.4")
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):
@@ -797,8 +872,10 @@ class Gateway:
                 if err:
                     return self._json(400, err)
                 stream = bool(body.get("stream"))
+                ctx = parse_traceparent(self.headers.get("traceparent"))
                 try:
-                    status, resp = gw.handle_completion(body, stream=stream)
+                    status, resp = gw.handle_completion(body, stream=stream,
+                                                        trace=ctx)
                     if stream and status == 200 and not isinstance(resp, dict):
                         return self._relay_sse(resp)
                 except Exception as e:  # noqa: BLE001

@@ -1,5 +1,6 @@
-"""Shared stdlib-HTTP plumbing for the serving stack's three servers
-(:mod:`.api`, :mod:`.gateway`, :mod:`.moderation`)."""
+"""Shared stdlib-HTTP plumbing for the serving stack's servers
+(:mod:`.api`, :mod:`.gateway`, :mod:`.moderation`, and the kv-pool's
+metrics sidecar)."""
 
 from __future__ import annotations
 
@@ -40,3 +41,28 @@ class JsonHandler(BaseHTTPRequestHandler):
             return json.loads(self.rfile.read(length) or b"{}"), None
         except (ValueError, json.JSONDecodeError):
             return None, {"error": {"message": "invalid JSON body"}}
+
+
+def serve_obs_get(handler: JsonHandler, metrics_text, tracer=None) -> bool:
+    """Serve the observability GET triplet every server in the stack
+    exposes (docs/observability.md) — ``/health``, ``/metrics``
+    (Prometheus text exposition), ``/debug/traces`` (bounded span ring
+    grouped by trace id). Returns True when the path was handled.
+
+    ``metrics_text`` is a zero-arg callable; ``tracer`` defaults to the
+    process tracer (servers constructed with their own pass it in)."""
+    if handler.path == "/health":
+        handler._json(200, {"status": "ok"})
+        return True
+    if handler.path == "/metrics":
+        handler._text(200, metrics_text().encode(),
+                      "text/plain; version=0.0.4")
+        return True
+    if handler.path == "/debug/traces":
+        if tracer is None:
+            from llm_in_practise_tpu.obs.trace import get_tracer
+
+            tracer = get_tracer()
+        handler._json(200, tracer.debug_payload())
+        return True
+    return False

@@ -257,6 +257,7 @@ class KVPoolServer:
         self.max_namespaces = max_namespaces
         self.max_payload = min(max_payload, max_bytes)
         self.rejected = 0             # puts refused (ns budget / size caps)
+        self.evictions = 0            # LRU entries dropped (token/byte caps)
         self._unknown_ns_misses = 0   # gets for namespaces never put to
         # per-connection fault containment: protocol/transport faults are
         # logged and counted, and tear down THAT connection only — the
@@ -333,6 +334,14 @@ class KVPoolServer:
         self.address = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
+        # Prometheus sidecar endpoint: the binary TCP protocol above is
+        # the data plane; this registry/HTTP pair is the scrape plane —
+        # without it the platform's shared-cache tier was invisible to
+        # Prometheus (counters reachable only via the `stats` op).
+        from llm_in_practise_tpu.obs.registry import Registry
+
+        self.registry = self._build_registry(Registry())
+        self._metrics_httpd = None
 
     def start(self) -> "KVPoolServer":
         self._thread.start()
@@ -341,6 +350,86 @@ class KVPoolServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()
+            self._metrics_httpd = None
+
+    # -- metrics exposition ---------------------------------------------------
+
+    def _build_registry(self, reg):
+        reg.counter_func("kvpool_hits_total", lambda: self.hits,
+                         "prefix lookups served from the pool")
+        reg.counter_func("kvpool_misses_total", lambda: self.misses,
+                         "prefix lookups that found nothing "
+                         "(incl. unknown namespaces)")
+        reg.counter_func("kvpool_evictions_total", lambda: self.evictions,
+                         "LRU entries dropped under token/byte pressure")
+        reg.counter_func("kvpool_rejected_total", lambda: self.rejected,
+                         "puts refused (namespace budget / size caps)")
+        reg.counter_func("kvpool_conn_errors_total",
+                         lambda: self.conn_errors,
+                         "connections torn down on protocol/transport "
+                         "faults")
+        reg.gauge_func("kvpool_entries", lambda: self._store.n_entries)
+        reg.gauge_func("kvpool_cached_tokens",
+                       lambda: (self._store.cached_tokens
+                                - self._store.n_entries))
+        reg.gauge_func("kvpool_cached_bytes", lambda: self.cached_bytes,
+                       "bytes pinned by LRU entries (RAM in use)")
+        reg.gauge_func("kvpool_namespaces",
+                       lambda: len(self._namespaces))
+        reg.counter_func(
+            "kvpool_handoff_total",
+            lambda: [({"event": "pinned"}, self.handoff_puts),
+                     ({"event": "claimed"}, self.handoff_claims),
+                     ({"event": "ttl_reclaimed"}, self.handoff_expired),
+                     ({"event": "rejected"}, self.handoff_rejected)],
+            "disaggregated handoff pins/claims/TTL-reclaims/refusals")
+        reg.gauge_func("kvpool_handoff_pending",
+                       lambda: len(self._handoff))
+        reg.gauge_func("kvpool_handoff_bytes",
+                       lambda: self._handoff_bytes,
+                       "bytes pinned by unclaimed handoff entries")
+        return reg
+
+    def metrics_text(self) -> str:
+        return self.registry.render()
+
+    def serve_metrics(self, host: str = "0.0.0.0", port: int = 8101) -> int:
+        """Start the HTTP ``/metrics`` (+``/health``) endpoint next to
+        the TCP data plane; returns the bound port. Idempotent-ish:
+        call once, from the owner."""
+        import http.server
+
+        from llm_in_practise_tpu.serve.http_util import (
+            JsonHandler, serve_obs_get,
+        )
+
+        pool = self
+
+        class Handler(JsonHandler):
+            def do_GET(self):
+                # the pool process records no spans of its own yet, but
+                # /debug/traces is part of every server's contract —
+                # and colocated stacks DO share the process tracer
+                if not serve_obs_get(self, pool.metrics_text):
+                    self._json(404, {"error": {"message": "not found"}})
+
+        class Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        if self._metrics_httpd is not None:  # re-serve: don't leak the
+            # prior listener (its thread would keep the old port bound)
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()
+            self._metrics_httpd = None
+        self._metrics_httpd = Server((host, port), Handler)
+        bound = self._metrics_httpd.server_address[1]
+        threading.Thread(target=self._metrics_httpd.serve_forever,
+                         daemon=True).start()
+        return bound
 
     # -- ops ----------------------------------------------------------------
 
@@ -352,6 +441,7 @@ class KVPoolServer:
 
     def _on_evict(self, key, value) -> None:
         with self._acct_lock:
+            self.evictions += 1
             self._total_bytes -= len(value[2])
             ns = key[0]
             n = self._ns_counts.get(ns, 0) - 1
@@ -756,11 +846,24 @@ def main() -> None:
                    help="global pool budget in blob bytes — size this to "
                         "the pod's memory limit minus headroom")
     p.add_argument("--max-namespaces", type=int, default=64)
+    p.add_argument("--metrics-port", type=int, default=8101,
+                   help="HTTP port for Prometheus /metrics (+/health) "
+                        "next to the TCP data plane; 0 disables")
     args = p.parse_args()
     server = KVPoolServer(args.host, args.port, max_tokens=args.max_tokens,
                           max_bytes=args.max_bytes,
                           max_namespaces=args.max_namespaces)
     server.start()
+    if args.metrics_port:
+        try:
+            mport = server.serve_metrics(args.host, args.metrics_port)
+            print(f"kv pool metrics on {args.host}:{mport}/metrics")
+        except OSError as e:
+            # a second pool on the host collides on the default 8101 —
+            # the data plane (already up) must survive with metrics
+            # disabled, not crash a previously-working topology
+            print(f"kv pool metrics DISABLED: cannot bind "
+                  f"{args.host}:{args.metrics_port} ({e})")
     print(f"kv pool server on {server.address[0]}:{server.address[1]} "
           f"(budget {args.max_tokens} tokens / {args.max_bytes} bytes)")
     try:

@@ -23,7 +23,8 @@ import threading
 from dataclasses import dataclass, field
 from http.server import ThreadingHTTPServer
 
-from llm_in_practise_tpu.serve.http_util import JsonHandler
+from llm_in_practise_tpu.obs.registry import Registry
+from llm_in_practise_tpu.serve.http_util import JsonHandler, serve_obs_get
 
 # Llama-Guard-3 hazard taxonomy → OpenAI moderation categories
 # (openai_moderation_map.py behavior).
@@ -79,6 +80,19 @@ class ModerationService:
     requests_total: int = 0
     flagged_total: int = 0
     _httpd: ThreadingHTTPServer | None = None
+    _registry: Registry | None = None
+
+    def metrics_text(self) -> str:
+        if self._registry is None:
+            reg = Registry()
+            reg.counter_func("moderation_requests_total",
+                             lambda: self.requests_total,
+                             help="inputs scored by the classifier")
+            reg.counter_func("moderation_flagged_total",
+                             lambda: self.flagged_total,
+                             help="inputs flagged in any category")
+            self._registry = reg
+        return self._registry.render()
 
     def moderate(self, text: str) -> dict:
         """One input → OpenAI moderation result dict."""
@@ -117,8 +131,8 @@ class ModerationService:
 
         class Handler(JsonHandler):
             def do_GET(self):
-                if self.path == "/health":
-                    return self._json(200, {"status": "ok"})
+                if serve_obs_get(self, svc.metrics_text):
+                    return
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):

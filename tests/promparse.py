@@ -1,0 +1,234 @@
+"""Strict Prometheus text-exposition parser (test helper).
+
+Stricter than Prometheus itself on the points the unified registry
+guarantees (the bugs the registry migration fixed were precisely
+"Prometheus-the-server tolerated it, strict parsers didn't"):
+
+- every sample must belong to a family with a ``# TYPE`` header that
+  appears BEFORE the sample;
+- histogram families must be ``_bucket``/``_count``/``_sum`` consistent:
+  cumulative bucket counts, a ``+Inf`` bucket equal to ``_count``, and
+  matching label sets;
+- label names are valid identifiers, label values properly quoted with
+  only the spec's escapes (``\\\\``, ``\\"``, ``\\n``);
+- no duplicate samples, no NaN values, no negative counters.
+
+``parse_exposition(text)`` returns ``{family_name: Family}``;
+``assert_counters_monotone(before, after)`` compares two scrapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+@dataclasses.dataclass
+class Family:
+    name: str
+    kind: str
+    # (sample_name, frozenset(label items)) -> float
+    samples: dict = dataclasses.field(default_factory=dict)
+
+
+class ExpositionError(AssertionError):
+    pass
+
+
+def _parse_labels(raw: str) -> dict:
+    """Parse the inside of ``{...}`` strictly (char-by-char: quoted
+    values, spec escapes only)."""
+    labels: dict[str, str] = {}
+    i = 0
+    n = len(raw)
+    while i < n:
+        eq = raw.find("=", i)
+        if eq < 0:
+            raise ExpositionError(f"malformed labels: {raw!r}")
+        name = raw[i:eq]
+        if not _LABEL_NAME_RE.match(name):
+            raise ExpositionError(f"bad label name {name!r} in {raw!r}")
+        if eq + 1 >= n or raw[eq + 1] != '"':
+            raise ExpositionError(f"unquoted label value in {raw!r}")
+        i = eq + 2
+        out = []
+        while True:
+            if i >= n:
+                raise ExpositionError(f"unterminated label value in {raw!r}")
+            ch = raw[i]
+            if ch == "\\":
+                if i + 1 >= n:
+                    raise ExpositionError(f"dangling escape in {raw!r}")
+                esc = raw[i + 1]
+                if esc == "n":
+                    out.append("\n")
+                elif esc in ('"', "\\"):
+                    out.append(esc)
+                else:
+                    raise ExpositionError(
+                        f"invalid escape \\{esc} in {raw!r}")
+                i += 2
+                continue
+            if ch == '"':
+                i += 1
+                break
+            out.append(ch)
+            i += 1
+        if name in labels:
+            raise ExpositionError(f"duplicate label {name!r} in {raw!r}")
+        labels[name] = "".join(out)
+        if i < n:
+            if raw[i] != ",":
+                raise ExpositionError(
+                    f"expected ',' between labels in {raw!r}")
+            i += 1
+    return labels
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (.+)$")
+
+
+def _family_of(sample_name: str, families: dict) -> Family | None:
+    """Resolve a sample to its declared family (histogram/summary
+    samples carry suffixes)."""
+    if sample_name in families:
+        return families[sample_name]
+    for suffix in ("_bucket", "_count", "_sum"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            fam = families.get(base)
+            if fam is not None and (
+                    fam.kind in ("histogram", "summary")
+                    and (suffix != "_bucket" or fam.kind == "histogram")):
+                return fam
+    return None
+
+
+def parse_exposition(text: str) -> dict[str, Family]:
+    families: dict[str, Family] = {}
+    for lineno, line in enumerate(text.split("\n"), start=1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) != 4:
+                    raise ExpositionError(
+                        f"line {lineno}: malformed TYPE line {line!r}")
+                _, _, name, kind = parts
+                if not _NAME_RE.match(name):
+                    raise ExpositionError(
+                        f"line {lineno}: bad family name {name!r}")
+                if kind not in _TYPES:
+                    raise ExpositionError(
+                        f"line {lineno}: bad family type {kind!r}")
+                if name in families:
+                    raise ExpositionError(
+                        f"line {lineno}: duplicate TYPE for {name!r}")
+                families[name] = Family(name, kind)
+            continue  # HELP / comments
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ExpositionError(f"line {lineno}: unparsable {line!r}")
+        sname, rawlabels, rawvalue = m.group(1), m.group(2), m.group(3)
+        fam = _family_of(sname, families)
+        if fam is None:
+            raise ExpositionError(
+                f"line {lineno}: sample {sname!r} has no preceding "
+                f"# TYPE header (strict parsers reject this)")
+        labels = _parse_labels(rawlabels) if rawlabels else {}
+        try:
+            value = float(rawvalue)
+        except ValueError:
+            raise ExpositionError(
+                f"line {lineno}: bad value {rawvalue!r}")
+        if math.isnan(value):
+            raise ExpositionError(f"line {lineno}: NaN value")
+        if fam.kind == "counter" and value < 0:
+            raise ExpositionError(
+                f"line {lineno}: negative counter {sname}")
+        key = (sname, frozenset(labels.items()))
+        if key in fam.samples:
+            raise ExpositionError(
+                f"line {lineno}: duplicate sample {sname}{labels}")
+        fam.samples[key] = value
+    _check_histograms(families)
+    return families
+
+
+def _check_histograms(families: dict[str, Family]) -> None:
+    for fam in families.values():
+        if fam.kind != "histogram":
+            continue
+        # group by the non-le label set
+        series: dict[frozenset, dict] = {}
+        for (sname, labelset), value in fam.samples.items():
+            labels = dict(labelset)
+            if sname == fam.name + "_bucket":
+                if "le" not in labels:
+                    raise ExpositionError(
+                        f"{fam.name}_bucket sample without le label")
+                le = labels.pop("le")
+                key = frozenset(labels.items())
+                series.setdefault(key, {"buckets": [], "count": None,
+                                        "sum": None})
+                bound = float("inf") if le == "+Inf" else float(le)
+                series[key]["buckets"].append((bound, value))
+            elif sname == fam.name + "_count":
+                key = frozenset(labels.items())
+                series.setdefault(key, {"buckets": [], "count": None,
+                                        "sum": None})
+                series[key]["count"] = value
+            elif sname == fam.name + "_sum":
+                key = frozenset(labels.items())
+                series.setdefault(key, {"buckets": [], "count": None,
+                                        "sum": None})
+                series[key]["sum"] = value
+            else:
+                raise ExpositionError(
+                    f"unexpected histogram sample {sname!r}")
+        if not series:
+            raise ExpositionError(
+                f"histogram {fam.name} declared but has no samples")
+        for key, got in series.items():
+            if got["count"] is None or got["sum"] is None:
+                raise ExpositionError(
+                    f"{fam.name}{dict(key)}: missing _count or _sum")
+            buckets = sorted(got["buckets"])
+            if not buckets or buckets[-1][0] != float("inf"):
+                raise ExpositionError(
+                    f"{fam.name}{dict(key)}: no +Inf bucket")
+            prev = 0.0
+            for bound, cum in buckets:
+                if cum < prev:
+                    raise ExpositionError(
+                        f"{fam.name}{dict(key)}: bucket counts not "
+                        f"cumulative at le={bound}")
+                prev = cum
+            if buckets[-1][1] != got["count"]:
+                raise ExpositionError(
+                    f"{fam.name}{dict(key)}: +Inf bucket "
+                    f"{buckets[-1][1]} != _count {got['count']}")
+
+
+def assert_counters_monotone(before: dict[str, Family],
+                             after: dict[str, Family]) -> None:
+    """Counters must never decrease between two scrapes of one server."""
+    for name, fam in before.items():
+        if fam.kind != "counter":
+            continue
+        fam2 = after.get(name)
+        if fam2 is None:
+            raise ExpositionError(
+                f"counter family {name!r} vanished between scrapes")
+        for key, value in fam.samples.items():
+            if key in fam2.samples and fam2.samples[key] < value:
+                raise ExpositionError(
+                    f"counter {key} decreased: {value} -> "
+                    f"{fam2.samples[key]}")

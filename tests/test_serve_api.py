@@ -124,12 +124,34 @@ def test_metrics_exposition(server):
     assert status == 200
     text = body.decode()
     assert "llm_requests_total" in text
-    assert "llm_ttft_seconds" in text
-    assert 'quantile="0.99"' in text
+    # TTFT/TPOT are bucketed histograms now (was: full-history
+    # summaries) — PromQL quantiles come from histogram_quantile()
+    assert "# TYPE llm_ttft_seconds histogram" in text
+    assert 'llm_ttft_seconds_bucket{le="+Inf"}' in text
+    assert "llm_ttft_seconds_count" in text
+    assert "llm_tpot_seconds_sum" in text
     # dispatch accounting (fused mixed-step observability)
     assert "llm_dispatches_total" in text
     assert "llm_dispatches_per_step" in text
     assert "llm_mixed_blocks_total" in text
+
+
+def test_debug_traces_endpoint(server):
+    """/debug/traces serves the span ring: a served request leaves an
+    api.chat span (and its engine phase spans) behind."""
+    status, _ = _post(server, "/v1/chat/completions", {
+        "model": "tiny-test",
+        "messages": [{"role": "user", "content": "trace me"}],
+        "max_tokens": 4, "temperature": 0.0,
+    })
+    assert status == 200
+    status, body = _get(server, "/debug/traces")
+    assert status == 200
+    payload = json.loads(body)
+    names = {s["name"] for t in payload["traces"] for s in t["spans"]}
+    assert "api.chat" in names
+    assert "engine.queue_wait" in names and "engine.decode" in names
+    assert payload["summary"]["spans_recorded"] >= 3
 
 
 def test_dead_engine_streaming_returns_503():

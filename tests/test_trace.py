@@ -1,0 +1,345 @@
+"""End-to-end request tracing across the disaggregated serving path.
+
+The acceptance bar (ISSUE 3): a single streamed request through
+gateway → prefill replica → kv-pool handoff → decode replica yields
+exactly ONE trace whose spans cover routing, handoff publish, claim,
+admission, and decode — all sharing the trace id — asserted against the
+full HTTP stack; and with tracing enabled the golden tokens are
+unchanged (the trace plane observes, never perturbs).
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
+from llm_in_practise_tpu.obs.trace import (
+    TraceContext,
+    Tracer,
+    format_traceparent,
+    new_context,
+    parse_traceparent,
+    set_tracer,
+)
+from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
+
+
+# --- tracer unit surface -----------------------------------------------------
+
+
+def test_traceparent_round_trip_and_strict_parse():
+    ctx = new_context()
+    parsed = parse_traceparent(format_traceparent(ctx))
+    assert parsed == ctx
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") \
+        is None                      # all-zero trace id is invalid
+    assert parse_traceparent("00-" + "a" * 32 + "-" + "0" * 16 + "-01") \
+        is None                      # all-zero span id is invalid
+
+
+def test_span_nesting_shares_trace_and_parents():
+    tr = Tracer(capacity=16, enabled=True)
+    with tr.span("root") as root:
+        with tr.span("child", parent=root) as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    spans = tr.spans()
+    assert {s["name"] for s in spans} == {"root", "child"}
+    assert all(s["duration_s"] >= 0 for s in spans)
+
+
+def test_ring_buffer_is_bounded():
+    tr = Tracer(capacity=8, enabled=True)
+    for i in range(100):
+        tr.record(f"s{i}", duration_s=0.001)
+    assert len(tr.spans()) == 8
+    assert tr.summary()["spans_recorded"] == 100
+
+
+def test_bad_trace_file_fails_open(tmp_path):
+    """An unwritable LLM_TPU_TRACE_FILE must not take down tracer (and
+    therefore engine/server) construction — the JSONL sink is disabled,
+    ring tracing keeps working."""
+    tr = Tracer(capacity=8, enabled=True,
+                trace_file=str(tmp_path / "missing" / "dir" / "t.jsonl"))
+    tr.record("survives", duration_s=0.001)
+    assert [s["name"] for s in tr.spans()] == ["survives"]
+    assert tr._file is None and tr._file_path is None
+
+
+def test_disabled_tracer_records_nothing_and_passes_context_through():
+    tr = Tracer(enabled=False)
+    ctx = TraceContext("ab" * 16, "cd" * 8)
+    sp = tr.start_span("x", parent=ctx)
+    sp.end()
+    assert sp.context() == ctx        # propagation degrades to pass-through
+    assert tr.spans() == [] and tr.summary()["spans_recorded"] == 0
+
+
+def test_disabled_tracer_nested_spans_unwrap_to_context():
+    # regression: the gateway's disagg path nests start_span under a
+    # no-op root span and then formats a traceparent from the child's
+    # context — the child must unwrap to the underlying TraceContext
+    # (or None), never hand back the parent no-op span itself
+    tr = Tracer(enabled=False)
+    root = tr.start_span("gateway.route")
+    child = tr.start_span("gateway.prefill_phase", parent=root)
+    assert child.context() is None    # rootless chain: nothing to format
+    ctx = TraceContext("ab" * 16, "cd" * 8)
+    root2 = tr.start_span("gateway.route", parent=ctx)
+    child2 = tr.start_span("gateway.prefill_phase", parent=root2)
+    assert child2.context() == ctx
+    assert format_traceparent(child2.context()).startswith("00-" + "ab" * 16)
+    # a no-op parent handed to an ENABLED tracer must not crash either
+    # (mixed-tracer stacks): it unwraps to its context
+    live = Tracer(enabled=True, capacity=4)
+    sp = live.start_span("api.chat", parent=child2)
+    assert sp.trace_id == ctx.trace_id and sp.parent_id == ctx.span_id
+    sp.end()
+    rootless = live.start_span("api.chat", parent=child)
+    assert rootless.parent_id is None  # fresh root, no crash
+    rootless.end()
+
+
+def test_chrome_trace_jsonl_sink(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tr = Tracer(enabled=True, trace_file=path)
+    with tr.span("op", op_kind="test"):
+        pass
+    tr.set_trace_file(None)
+    lines = [json.loads(line)
+             for line in open(path, encoding="utf-8") if line.strip()]
+    assert len(lines) == 1
+    ev = lines[0]
+    assert ev["ph"] == "X" and ev["name"] == "op"
+    assert ev["dur"] >= 0 and "trace_id" in ev["args"]
+    assert ev["args"]["op_kind"] == "test"
+
+
+# --- engine span instrumentation --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    cfg = GPTConfig(vocab_size=64, seq_len=192, n_layer=2, n_head=2,
+                    embed_dim=32, dropout=0.0, pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("cache_len", 192)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return InferenceEngine(model, params, **kw)
+
+
+PROMPT = [(i * 7 + 5) % 64 for i in range(40)]
+SP = SamplingParams(greedy=True, max_tokens=8)
+
+
+def test_engine_phase_spans_including_prefill_chunks(model_params):
+    model, params = model_params
+    tr = Tracer(capacity=256, enabled=True)
+    eng = _engine(model, params, chunked_prefill=8, tracer=tr)
+    ctx = new_context()
+    h = eng.submit(PROMPT, SP, trace=ctx)
+    while eng.step():
+        pass
+    assert len(h.result()) > 0
+    spans = [s for s in tr.spans() if s["trace_id"] == ctx.trace_id]
+    names = [s["name"] for s in spans]
+    assert "engine.queue_wait" in names
+    assert "engine.admit" in names
+    assert "engine.decode" in names
+    # a 40-token prompt over chunk=8 runs several chunk dispatches
+    assert names.count("engine.prefill_chunk") >= 4
+    assert all(s["parent_id"] == ctx.span_id for s in spans)
+
+
+def test_untraced_requests_record_no_spans(model_params):
+    model, params = model_params
+    tr = Tracer(capacity=64, enabled=True)
+    eng = _engine(model, params, tracer=tr)
+    eng.generate(PROMPT, SP)
+    assert tr.spans() == []
+
+
+def test_golden_tokens_unchanged_with_tracing_enabled(model_params):
+    """The trace plane observes, never perturbs: traced vs untraced
+    greedy outputs are bit-identical."""
+    model, params = model_params
+    ref = _engine(model, params).generate(PROMPT, SP)
+    tr = Tracer(capacity=256, enabled=True)
+    eng = _engine(model, params, tracer=tr)
+    h = eng.submit(PROMPT, SP, trace=new_context())
+    while eng.step():
+        pass
+    assert h.result() == ref
+    assert tr.summary()["spans_recorded"] >= 3
+
+
+# --- the full disaggregated HTTP stack ---------------------------------------
+
+
+def test_one_trace_across_gateway_prefill_pool_decode(model_params):
+    """One streamed request through the whole 11-disagg stage leaves
+    exactly one trace covering routing, handoff publish, claim,
+    admission, and decode — all hops correlated by the propagated
+    trace id — and answers bit-identically to a colocated engine."""
+    from llm_in_practise_tpu.serve import schemas
+    from llm_in_practise_tpu.serve.api import OpenAIServer, build_prompt
+    from llm_in_practise_tpu.serve.disagg import RemoteHandoff
+    from llm_in_practise_tpu.serve.gateway import (
+        DisaggRouter, Gateway, RetryPolicy, Upstream,
+    )
+    from llm_in_practise_tpu.serve.kv_pool import KVPoolServer
+
+    class ByteTok:
+        def encode(self, text):
+            return [b % 64 for b in
+                    text.encode("utf-8", errors="replace")][:60]
+
+        def decode(self, ids):
+            return "".join(chr(33 + int(i) % 64) for i in ids)
+
+    model, params = model_params
+    tok = ByteTok()
+    body = {"model": "m", "max_tokens": 8, "temperature": 0.0,
+            "stream": True,
+            "messages": [{"role": "user", "content": "trace me"}]}
+    prompt_ids = tok.encode(build_prompt(
+        [schemas.ChatMessage(m["role"], m["content"])
+         for m in body["messages"]]))
+    ref_text = tok.decode(_engine(model, params).generate(
+        prompt_ids, SamplingParams(temperature=0.0, greedy=True,
+                                   max_tokens=8)))
+
+    # fresh PROCESS tracer: every in-process component (both servers,
+    # the gateway, both engines) records into one ring — the single
+    # pane /debug/traces serves
+    tracer = set_tracer(Tracer(capacity=1024, enabled=True))
+    pool = KVPoolServer(min_prefix=4).start()
+    servers, port = [], {}
+    try:
+        for role in ("prefill", "decode"):
+            store = RemoteHandoff(pool.address, namespace="m")
+            eng = _engine(model, params, role=role,
+                          handoff=store if role == "prefill" else None)
+            srv = OpenAIServer(eng, tok, model_name="m", role=role,
+                               handoff=store if role == "decode" else None)
+            port[role] = srv.serve(host="127.0.0.1", port=0,
+                                   background=True)
+            servers.append(srv)
+        gw = Gateway(DisaggRouter([
+            Upstream(f"http://127.0.0.1:{port['prefill']}", "m",
+                     group="m", role="prefill"),
+            Upstream(f"http://127.0.0.1:{port['decode']}", "m",
+                     group="m", role="decode")]),
+            retry_policy=RetryPolicy(backoff_s=0.01),
+            health_check_interval_s=0)
+        status, handle = gw.handle_completion(dict(body), stream=True)
+        assert status == 200
+        raw = b""
+        while True:
+            chunk = handle.read(4096)
+            if not chunk:
+                break
+            raw += chunk
+        handle.close()
+        events = [json.loads(line[6:])
+                  for line in raw.decode().split("\n")
+                  if line.startswith("data: ") and "[DONE]" not in line]
+        text = "".join(e["choices"][0]["delta"].get("content", "")
+                       for e in events if "choices" in e)
+        assert text == ref_text            # golden under tracing
+
+        roots = [s for s in tracer.spans()
+                 if s["name"] == "gateway.route"]
+        assert len(roots) == 1
+        tid = roots[0]["trace_id"]
+        trace = tracer.trace(tid)
+        names = [s["name"] for s in trace]
+        # ONE trace covers every hop of the disaggregated path
+        for required in ("gateway.route",          # routing
+                         "gateway.prefill_phase",  # two-phase dispatch
+                         "api.prefill",            # prefill replica
+                         "engine.queue_wait",
+                         "engine.admit",
+                         "handoff.publish",        # KV pinned to pool
+                         "api.chat",               # decode replica
+                         "handoff.claim",          # KV claimed from pool
+                         "engine.decode",          # interference-free
+                         "api.stream_flush"):      # client-visible tail
+            assert required in names, (required, sorted(set(names)))
+        # ... and nothing leaked into a second trace: every span of
+        # every component belongs to this one request
+        other = {s["trace_id"] for s in tracer.spans()} - {tid}
+        assert not other, f"spans outside the request trace: {other}"
+        # the decode replica claimed (never re-prefilled), and its admit
+        # span is the direct-insert admission
+        dec_eng = servers[1].engine
+        assert dec_eng.kv_admitted == 1 and dec_eng.local_prefills == 0
+        assert dec_eng.mixed_blocks == 0
+        admit = [s for s in trace if s["name"] == "engine.admit"
+                 and s["attrs"].get("path") == "kv_direct_insert"]
+        assert admit, "decode admission should be the KV direct insert"
+        claim = next(s for s in trace if s["name"] == "handoff.claim")
+        assert claim["attrs"]["found"] is True
+        publish = next(s for s in trace if s["name"] == "handoff.publish")
+        assert publish["attrs"]["ok"] is True
+
+        # /debug/traces serves the same trace over HTTP
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port['decode']}/debug/traces") as r:
+            payload = json.loads(r.read())
+        assert any(t["trace_id"] == tid for t in payload["traces"])
+    finally:
+        for srv in servers:
+            srv.shutdown()
+        pool.stop()
+        set_tracer(Tracer())   # leave a clean default for other tests
+
+
+def test_client_supplied_traceparent_is_adopted(model_params):
+    """A client traceparent header roots the whole server-side trace —
+    external tracing systems correlate straight through."""
+    from llm_in_practise_tpu.serve.api import OpenAIServer
+
+    class ByteTok:
+        def encode(self, text):
+            return [b % 64 for b in
+                    text.encode("utf-8", errors="replace")][:60]
+
+        def decode(self, ids):
+            return "".join(chr(33 + int(i) % 64) for i in ids)
+
+    model, params = model_params
+    tr = Tracer(capacity=128, enabled=True)
+    eng = _engine(model, params, tracer=tr)
+    srv = OpenAIServer(eng, ByteTok(), model_name="m", tracer=tr)
+    port = srv.serve(host="127.0.0.1", port=0, background=True)
+    try:
+        ctx = new_context()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            data=json.dumps({
+                "model": "m", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "hi"}]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": format_traceparent(ctx)})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+        chat = [s for s in tr.spans() if s["name"] == "api.chat"]
+        assert len(chat) == 1
+        assert chat[0]["trace_id"] == ctx.trace_id
+        assert chat[0]["parent_id"] == ctx.span_id
+    finally:
+        srv.shutdown()

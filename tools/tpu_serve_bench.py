@@ -138,8 +138,17 @@ def main() -> None:
         http_levels.append(r)
         print(json.dumps(r), flush=True)
 
+    # observability snapshot BEFORE shutdown: the /metrics exposition
+    # (dispatch accounting, TTFT/TPOT histograms) and the trace-ring
+    # summary ride in the artifact, so a perf regression in these rows
+    # arrives with its per-phase breakdown attached (bench.obs_snapshot)
+    from bench import obs_snapshot
+
+    observability = obs_snapshot(server=srv)
+
     srv.shutdown()  # also stops the engine thread it owns
     artifact = {
+        "observability": observability,
         "device": jax.devices()[0].device_kind,
         "model": "GPTLike 6L/512d bf16 (~36M params) — NOT 8B; see header",
         "engine": {"max_slots": MAX_SLOTS, "cache_len": 1024,

@@ -282,8 +282,11 @@ def main() -> None:
         engine.prefix_cache = PrefixCache(max_tokens=32768)
 
         def _ttft(ids):
+            from llm_in_practise_tpu.obs.trace import new_context
+
             req = engine.submit(
-                ids, SamplingParams(greedy=True, max_tokens=4))
+                ids, SamplingParams(greedy=True, max_tokens=4),
+                trace=new_context())
             req.result()
             if req.ttft_s is None:  # shed/failed probe: fail loudly now,
                 raise RuntimeError(  # not as a TypeError after the run
@@ -332,8 +335,13 @@ def main() -> None:
         levels.append(r)
         print(json.dumps(r), flush=True)
 
+    from bench import obs_snapshot
+
     engine.stop()
     artifact = {
+        # trace-ring summary (per-phase span counts/seconds): the
+        # latency breakdown that turns a regressed row into a diagnosis
+        "observability": obs_snapshot(),
         "device": jax.devices()[0].device_kind,
         "model": f"Qwen3-arch d{cfg.hidden_size}/L{n_layer}, vocab "
                  f"151936, distinct-per-layer {FMT.upper()}, "
