@@ -44,14 +44,19 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-# bf16 peak FLOP/s by device_kind substring (first match wins).
-PEAKS = (
-    ("v6 lite", 918e12), ("v6e", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
+# The FLOP/peak/byte accounting lives in ONE place — obs/cost.py (the
+# serving stack's live MFU/bandwidth gauges divide by the same model
+# this bench's artifact numbers do; tests pin the equivalence).
+# Re-exported here because the standalone tools and earlier artifacts
+# import them as bench.* — one definition, no drift.
+from llm_in_practise_tpu.obs.cost import (  # noqa: F401 (re-exports)
+    PEAKS,
+    chip_peak,
+    flops_per_token,
+    hbm_stats as _hbm_stats,
+    matmul_param_count,
 )
+
 A100_PEAK = 312e12
 A100_MFU_EST = 0.35  # generous for an A100 bitsandbytes QLoRA stack
 
@@ -124,54 +129,6 @@ def init_backend_with_retry(max_attempts: int = 6, base_delay_s: float = 15.0,
     raise RuntimeError(
         f"backend init failed after {max_attempts} attempts "
         f"(~{total_budget_s:.0f}s budget): {last_err}")
-
-
-def chip_peak() -> tuple[str, float]:
-    kind = jax.devices()[0].device_kind
-    low = kind.lower()
-    for sub, peak in PEAKS:
-        if sub in low:
-            return kind, peak
-    return kind, 197e12  # conservative fallback
-
-
-def matmul_param_count(params, *, tied_head: bool) -> int:
-    """Total elements of kernels that run as matmuls per token: every 2-D
-    leaf except the embedding gather; the tied head re-uses the embedding
-    as a true matmul, so it is added back once."""
-    from llm_in_practise_tpu.utils.tree import flatten_with_paths
-
-    n = 0
-    embed_size = 0
-    for path, leaf in flatten_with_paths(params).items():
-        # kernels only — in stacked (scan/MoE) layouts norm scales are
-        # 2-D too, but they never hit the MXU. 3-D kernels' full size is
-        # the per-token matmul weight count.
-        if not (path.endswith("/kernel") or path.endswith("/embedding")):
-            continue
-        if getattr(leaf, "ndim", 0) not in (2, 3):
-            continue
-        if "tok_embed" in path or "pos_embed" in path:
-            embed_size = max(embed_size, leaf.size)
-            continue
-        n += leaf.size
-    if tied_head:
-        n += embed_size
-    return n
-
-
-def flops_per_token(m: int, n_layer: int, seq: int, dim: int,
-                    *, train_full: bool) -> float:
-    """Per-token FLOPs. ``m`` = matmul param elements (2 FLOPs each fwd);
-    attention (causal, avg S/2 keys): QK^T + AV = 4·(S/2)·D per layer fwd.
-    Full training = 3× fwd (bwd = dX + dW). QLoRA freezes the base, so the
-    weight-gradient matmuls are skipped: 2× fwd for the matmul part, but
-    attention backward is still full (no weights there) = 3× its fwd."""
-    matmul_fwd = 2.0 * m
-    attn_fwd = 2.0 * n_layer * seq * dim  # 4·(S/2)·D per layer
-    if train_full:
-        return 3.0 * (matmul_fwd + attn_fwd)
-    return 2.0 * matmul_fwd + 3.0 * attn_fwd
 
 
 SEQ = 1024  # training sequence length for every QLoRA rung
@@ -405,26 +362,6 @@ def _distinct_base_stacked(cfg, Qwen3, *, fmt: str = "nf4"):
     jax.block_until_ready(stacked)
     return ({**stem, "blocks": {"block": stacked}},
             time.perf_counter() - t0)
-
-
-def _hbm_stats() -> dict:
-    """Whatever memory facts the runtime reports — each key optional, so
-    a backend exposing only ``bytes_limit`` still informs the skip
-    bound (the axon tunnel reports nothing and returns {})."""
-    try:
-        s = jax.local_devices()[0].memory_stats() or {}
-    except Exception:
-        return {}
-    used = s.get("bytes_in_use")
-    limit = s.get("bytes_limit")
-    out = {}
-    if used is not None:
-        out["hbm_bytes_in_use"] = int(used)
-    if limit is not None:
-        out["hbm_bytes_limit"] = int(limit)
-    if used is not None and limit is not None:
-        out["hbm_headroom_gib"] = round((limit - used) / 2**30, 2)
-    return out
 
 
 def _qlora_ladder(peak: float, shapes: list,
@@ -811,13 +748,15 @@ def bench_gptlike(peak: float) -> dict:
         "gptlike bench failed everywhere:\n" + "\n".join(errors))
 
 
-def obs_snapshot(server=None) -> dict:
+def obs_snapshot(server=None, engine=None) -> dict:
     """Observability snapshot attached to every BENCH_* artifact: the
     process trace-ring summary (per-span-name counts and total seconds
     — the dispatch/latency breakdown behind the headline number) plus,
-    when a serving stack is in the loop, its full /metrics exposition.
-    A perf regression with this block attached says WHERE the time
-    went; one without it is a wall-clock guess."""
+    when a serving stack is in the loop, its full /metrics exposition
+    and the device plane (per-phase MFU / HBM-bandwidth utilization,
+    peak HBM bytes, compile seconds, SLO goodput — docs/observability.md
+    "Device plane"). A perf regression with this block attached says
+    WHERE the time went; one without it is a wall-clock guess."""
     snap = {}
     try:
         from llm_in_practise_tpu.obs.trace import get_tracer
@@ -833,7 +772,41 @@ def obs_snapshot(server=None) -> dict:
         except Exception as e:  # noqa: BLE001 — a scrape failure must
             # not kill the artifact
             snap["metrics_error"] = f"{type(e).__name__}: {e}"
+    if engine is None and server is not None:
+        engine = getattr(server, "engine", None)
+    try:
+        snap["device_plane"] = device_plane_snapshot(engine)
+    except Exception as e:  # noqa: BLE001 — same artifact-assembly rule
+        snap["device_plane_error"] = f"{type(e).__name__}: {e}"
     return snap
+
+
+def device_plane_snapshot(engine=None) -> dict:
+    """The device-plane half of a bench artifact: HBM occupancy (incl.
+    peak when the backend reports it), and — with a live engine —
+    per-phase dispatch MFU/bandwidth accounting, compile telemetry, and
+    the SLO-goodput split."""
+    from llm_in_practise_tpu.obs.cost import device_memory_stats
+
+    out = {"hbm": device_memory_stats()}
+    if engine is not None:
+        out["dispatch_phases"] = engine.dispatch_meter.phase_snapshot()
+        cmeter = engine.compile_meter
+        out["compile"] = {"events": cmeter.compile_events,
+                          "seconds": round(cmeter.compile_seconds, 3)}
+        cm = engine.cost_model
+        if cm is not None:
+            out["cost_model"] = {
+                "device_kind": cm.device_kind,
+                "peak_flops": cm.peak_flops,
+                "peak_hbm_bw": cm.peak_hbm_bw,
+                "weight_bytes": cm.weight_bytes,
+                "kv_bytes_per_token": cm.kv_bytes_per_token,
+            }
+        goodput = engine.stats.goodput
+        if goodput.enabled:
+            out["goodput"] = goodput.snapshot()
+    return out
 
 
 def main() -> None:

@@ -78,6 +78,17 @@ def main():
                         "for every routed request's spans to PATH — "
                         "open in Perfetto; the span ring is always on "
                         "at GET /debug/traces")
+    p.add_argument("--ttft-slo", dest="ttft_slo", type=float, default=None,
+                   metavar="SECONDS",
+                   help="SLO goodput: TTFT threshold — routed tokens of "
+                        "requests missing it count as "
+                        "llm_goodput_tokens_total{slo=violated}; "
+                        "violations are blamed per phase from the span "
+                        "ring (llm_slo_blame_total)")
+    p.add_argument("--tpot-slo", dest="tpot_slo", type=float, default=None,
+                   metavar="SECONDS",
+                   help="SLO goodput: per-token (TPOT) threshold "
+                        "(docs/observability.md device plane)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=4000)
     args = p.parse_args()
@@ -130,6 +141,8 @@ def main():
         cache=cache,
         fallbacks=fallbacks,
         moderation=gateway_hook(ModerationService()) if args.moderation else None,
+        ttft_slo_s=args.ttft_slo,
+        tpot_slo_s=args.tpot_slo,
     )
     scalers = []
     if args.autoscale:

@@ -115,6 +115,15 @@ def main():
                         "Perfetto / chrome://tracing; the in-memory "
                         "span ring is always on at GET /debug/traces "
                         "(LLM_TPU_TRACE=off disables tracing)")
+    p.add_argument("--ttft-slo", dest="ttft_slo", type=float, default=None,
+                   metavar="SECONDS",
+                   help="SLO goodput accounting: TTFT threshold — "
+                        "tokens of requests that miss it count as "
+                        "llm_goodput_tokens_total{slo=violated}")
+    p.add_argument("--tpot-slo", dest="tpot_slo", type=float, default=None,
+                   metavar="SECONDS",
+                   help="SLO goodput accounting: per-token (TPOT) "
+                        "threshold (docs/observability.md device plane)")
     p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype",
                    default="float32", choices=["float32", "bfloat16", "fp8"],
                    help="KV cache storage dtype; fp8 (e4m3) halves KV HBM "
@@ -275,6 +284,7 @@ def main():
         mixed_step=args.mixed_step,
         max_queue=args.max_queue,
         queue_timeout_s=args.queue_timeout,
+        ttft_slo_s=args.ttft_slo, tpot_slo_s=args.tpot_slo,
         draft_model=draft_model, draft_params=draft_params,
     )
     engine = InferenceEngine(model, params,

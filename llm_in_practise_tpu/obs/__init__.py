@@ -1,5 +1,8 @@
 """Observability: coordinator-gated logging, step metrics, profiling hooks,
-the unified metrics registry, and the request-span tracer.
+the unified metrics registry, the request-span tracer, and the device
+plane (the one analytic FLOP/byte cost model in
+:mod:`llm_in_practise_tpu.obs.cost`, on-demand profiler capture +
+compile telemetry in :mod:`llm_in_practise_tpu.obs.prof`).
 
 SURVEY §5.1/§5.5 — the reference's logging/metrics surface (env-level
 logging, rank-0 gating, rolling loss, epoch timing) plus the profiling it
@@ -11,6 +14,14 @@ counters); cross-hop request tracing lives in
 """
 
 from llm_in_practise_tpu.obs.logging import get_logger, setup_logging  # noqa: F401
+from llm_in_practise_tpu.obs.cost import (  # noqa: F401
+    CostModel,
+    chip_hbm_bw,
+    chip_peak,
+    device_memory_stats,
+    flops_per_token,
+    matmul_param_count,
+)
 from llm_in_practise_tpu.obs.debug import (  # noqa: F401
     disable_debug,
     enable_debug,
@@ -20,9 +31,15 @@ from llm_in_practise_tpu.obs.debug import (  # noqa: F401
 from llm_in_practise_tpu.obs.meter import (  # noqa: F401
     DispatchMeter,
     EpochTimer,
+    GoodputMeter,
     RollingMean,
     Throughput,
     profile_trace,
+)
+from llm_in_practise_tpu.obs.prof import (  # noqa: F401
+    CompileMeter,
+    ProfilerCapture,
+    get_profiler,
 )
 from llm_in_practise_tpu.obs.registry import (  # noqa: F401
     Counter,

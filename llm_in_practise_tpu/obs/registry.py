@@ -297,6 +297,13 @@ class Registry:
             self._families.append(family)
         return family
 
+    def family_names(self) -> frozenset:
+        """Names of every registered family — tools/check_metric_docs.py
+        walks the stack's default registries through this and fails when
+        a family is missing from docs/observability.md's catalog."""
+        with self._lock:
+            return frozenset(f.name for f in self._families)
+
     # -- instrument constructors ---------------------------------------------
 
     def counter(self, name, help="", labelnames=()) -> Counter:

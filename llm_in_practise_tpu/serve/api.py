@@ -45,7 +45,11 @@ from llm_in_practise_tpu.obs.registry import Registry
 from llm_in_practise_tpu.obs.trace import get_tracer, parse_traceparent
 from llm_in_practise_tpu.serve import schemas
 from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
-from llm_in_practise_tpu.serve.http_util import JsonHandler, serve_obs_get
+from llm_in_practise_tpu.serve.http_util import (
+    JsonHandler,
+    serve_obs_get,
+    serve_obs_post,
+)
 
 
 def build_prompt(messages) -> str:
@@ -511,6 +515,67 @@ class OpenAIServer:
         reg.counter_func("llm_mixed_blocks_total",
                          lambda: eng.mixed_blocks,
                          "fused prefill+decode dispatches")
+        # device plane (obs/cost.py + DispatchMeter.note_phase): live
+        # per-phase MFU / HBM-bandwidth-utilization / tokens-per-
+        # dispatch — the compute-vs-bandwidth-bound dial. Phases appear
+        # as they first dispatch; without a cost model (uncovered model
+        # family) the utilization gauges render no samples but the
+        # token gauge still does.
+        def _phase_gauge(field):
+            def read():
+                return [({"phase": phase}, snap[field])
+                        for phase, snap in dm.phase_snapshot().items()
+                        if snap.get(field) is not None]
+            return read
+
+        reg.gauge_func("llm_dispatch_mfu", _phase_gauge("mfu"),
+                       "rolling per-dispatch model FLOP utilization "
+                       "(useful FLOPs / wall time / chip peak)")
+        reg.gauge_func("llm_dispatch_hbm_bw_util",
+                       _phase_gauge("hbm_bw_util"),
+                       "rolling per-dispatch HBM bandwidth utilization "
+                       "(weights + KV traffic / wall time / peak BW)")
+        reg.gauge_func("llm_dispatch_tokens_per_dispatch",
+                       _phase_gauge("tokens_per_dispatch"),
+                       "rolling mean tokens processed per dispatch")
+        # compile telemetry (obs/prof.py CompileMeter over every jitted
+        # engine program): a serving-time recompile is a latency cliff
+        # this pair turns into an alertable counter
+        cmeter = eng.compile_meter
+        reg.counter_func("llm_compile_events_total",
+                         lambda: cmeter.compile_events,
+                         "jit executable-cache misses paid by the "
+                         "serving thread")
+        reg.counter_func("llm_compile_seconds_total",
+                         lambda: cmeter.compile_seconds,
+                         "cumulative seconds stalled in jit "
+                         "trace/compile (persistent-cache loads "
+                         "included)")
+        # device memory telemetry — read LIVE at scrape; backends that
+        # report no memory_stats (CPU, the axon tunnel) render the
+        # family with no samples (fail-open, bench.py:450 case)
+        def _hbm():
+            from llm_in_practise_tpu.obs.cost import device_memory_stats
+
+            stats = device_memory_stats()
+            return [({"kind": kind}, value)
+                    for kind, value in (("in_use",
+                                         stats.get("bytes_in_use")),
+                                        ("peak",
+                                         stats.get("peak_bytes_in_use")),
+                                        ("limit",
+                                         stats.get("bytes_limit")))
+                    if value is not None]
+
+        reg.gauge_func("llm_device_hbm_bytes", _hbm,
+                       "device memory from device.memory_stats(): "
+                       "bytes in use / peak / limit")
+        # SLO goodput (obs/meter.py GoodputMeter): tokens priced by
+        # whether their request met the TTFT/TPOT SLOs; zero until
+        # thresholds are configured (engine ttft_slo_s/tpot_slo_s)
+        from llm_in_practise_tpu.obs.meter import register_goodput
+
+        register_goodput(reg, s.goodput)
         # per-role latency labels (disaggregated serving): a prefill
         # replica's "TTFT" is KV-ready time, a decode replica's TPOT is
         # the interference-free number the split exists for. Plain
@@ -630,11 +695,14 @@ class OpenAIServer:
             def do_POST(self):
                 if self.path not in ("/v1/chat/completions",
                                      "/v1/embeddings",
-                                     "/internal/handoff/prefill"):
+                                     "/internal/handoff/prefill",
+                                     "/debug/profile"):
                     return self._json(404, {"error": {"message": "not found"}})
                 body, err = self._read_json()
                 if err:
                     return self._json(400, err)
+                if serve_obs_post(self, body):
+                    return None
                 # cross-hop trace continuity: the gateway (or any
                 # client) propagates a traceparent header; spans minted
                 # here join that trace instead of starting a new one

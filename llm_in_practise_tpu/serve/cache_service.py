@@ -109,6 +109,15 @@ class CacheService:
             from llm_in_practise_tpu.obs.trace import get_tracer
 
             return 200, get_tracer().debug_payload()
+        if method == "POST" and path == "/debug/profile":
+            # the observability POST every server exposes: bounded
+            # on-demand jax.profiler capture (obs/prof.py; one at a
+            # time process-wide)
+            from llm_in_practise_tpu.serve.http_util import (
+                obs_profile_response,
+            )
+
+            return obs_profile_response(body)
         if method == "POST" and path == "/cache/get":
             if not isinstance(body, dict):
                 return 422, {"error": "body must be the chat request"}

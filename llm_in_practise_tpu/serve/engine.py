@@ -44,8 +44,10 @@ import numpy as np
 
 from llm_in_practise_tpu.infer.generate import max_positions
 from llm_in_practise_tpu.infer.sampling import sample_token_batched
+from llm_in_practise_tpu.obs.cost import CostModel
 from llm_in_practise_tpu.obs.logging import get_logger
-from llm_in_practise_tpu.obs.meter import DispatchMeter
+from llm_in_practise_tpu.obs.meter import DispatchMeter, GoodputMeter
+from llm_in_practise_tpu.obs.prof import CompileMeter
 from llm_in_practise_tpu.obs.registry import HistogramAccumulator
 from llm_in_practise_tpu.obs.trace import get_tracer
 from llm_in_practise_tpu.serve.mixed_step import (
@@ -169,6 +171,11 @@ class EngineStats:
         self.queue_depth = 0
         self.active_slots = 0
         self.requests_shed = 0
+        # SLO goodput (obs/meter.py): inactive until thresholds are
+        # configured (engine ttft_slo_s/tpot_slo_s kwargs, or the serve
+        # benches post-warmup) — then every finished request's tokens
+        # land in llm_goodput_tokens_total{slo=ok|violated}
+        self.goodput = GoodputMeter()
 
     def observe_finished(self, req: Request):
         with self.lock:
@@ -180,6 +187,13 @@ class EngineStats:
             self.ttft.observe(req.ttft_s)
         if req.tpot_s is not None:
             self.tpot.observe(req.tpot_s)
+        if self.goodput.enabled and req.finish_reason != "queue_full":
+            # sheds are already counted (requests_shed / 429s); goodput
+            # prices the tokens the engine actually produced
+            self.goodput.observe(
+                tokens=req.n_generated, ttft_s=req.ttft_s,
+                tpot_s=req.tpot_s,
+                trace_id=getattr(req.trace, "trace_id", None))
 
 
 def _default_buckets(cache_len: int) -> tuple[int, ...]:
@@ -225,6 +239,8 @@ class InferenceEngine:
         role: str = "both",
         handoff=None,
         tracer=None,
+        ttft_slo_s: float | None = None,
+        tpot_slo_s: float | None = None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -482,11 +498,30 @@ class InferenceEngine:
         self.local_prefills = 0         # prefills a decode replica ran
         self._decode_prefill_logged = False
 
+        # SLO goodput thresholds (obs/meter.py GoodputMeter; exported
+        # as llm_goodput_tokens_total{slo=…}); the tracer enables
+        # per-phase blame of violated requests from the span ring
+        self.stats.goodput.tracer = self.tracer
+        if ttft_slo_s is not None or tpot_slo_s is not None:
+            self.stats.goodput.configure(ttft_slo_s, tpot_slo_s)
+
+        # Device-plane cost model (obs/cost.py): analytic FLOPs/bytes
+        # per dispatch → live per-phase MFU / HBM-bandwidth-utilization
+        # gauges. Fail-open None for model families the analytic
+        # geometry doesn't cover (the gauges just don't render).
+        self.cost_model = CostModel.from_model(model, params,
+                                               cache_dtype=cache_dtype)
+
         # Dispatch accounting: every jitted engine program is wrapped so
         # /metrics (llm_dispatches_*) and the mixed-step tests can assert
-        # dispatches/step instead of inferring it from wall-clock.
+        # dispatches/step instead of inferring it from wall-clock. The
+        # compile meter rides the same wrap: a jit-cache miss's call
+        # time is booked as compile seconds (llm_compile_*), so a 40 s
+        # recompile mid-serving is a counter bump, not a mystery stall.
         self.dispatch_meter = DispatchMeter()
-        _c = self.dispatch_meter.wrap
+        self.compile_meter = CompileMeter()
+        _c = lambda fn: self.dispatch_meter.wrap(  # noqa: E731
+            self.compile_meter.wrap(fn))
         self._decode = _c(jax.jit(self._decode_fn, donate_argnums=(1,)))
         self._decode_multi = _c(jax.jit(self._decode_multi_fn,
                                         donate_argnums=(1,),
@@ -977,6 +1012,24 @@ class InferenceEngine:
         self.tracer.record(name, req.trace, duration_s=duration_s,
                            uid=req.uid, **attrs)
 
+    def _note_device_phase(self, phase: str, *, tokens: int,
+                           attended_keys: float, weight_passes: float,
+                           kv_read_tokens: float, dt: float) -> None:
+        """Book one dispatch's device-plane sample (obs/cost.py → the
+        llm_dispatch_mfu / llm_dispatch_hbm_bw_util gauges). ``dt`` is
+        dispatch-issue + result-fetch wall time on this thread; with no
+        cost model only tokens-per-dispatch is recorded. Draft-model
+        dispatches are not booked (the cost model covers the target
+        model; the draft's work would inflate both utilizations)."""
+        cm = self.cost_model
+        mfu = bw = None
+        if cm is not None and dt > 0:
+            mfu = cm.mfu(cm.step_flops(tokens, attended_keys), dt)
+            bw = cm.hbm_util(
+                cm.step_bytes(weight_passes, kv_read_tokens, tokens), dt)
+        self.dispatch_meter.note_phase(phase, tokens=tokens, duration_s=dt,
+                                       mfu=mfu, hbm_bw_util=bw)
+
     def _admit(self) -> bool:
         """Move pending requests into free slots. Plain one-shot prefills
         (no prefix hit, no chunking) are collected and run as BATCHED
@@ -1112,6 +1165,7 @@ class InferenceEngine:
                 for j, (_, req, plen) in enumerate(part):
                     ids[j, :plen] = req.prompt_ids
                     lens[j] = plen
+                t0 = time.monotonic()
                 last, pre = self._prefill(
                     self.params, jnp.asarray(ids), jnp.asarray(lens))
                 slot_ids = np.array([p[0] for p in part], np.int32)
@@ -1131,6 +1185,17 @@ class InferenceEngine:
                     greedy=jnp.asarray(
                         [r.params.greedy for _, r, _ in part], bool),
                 ))
+                # device plane: useful (un-padded) tokens only, so
+                # bucket padding shows up as lost MFU — which it is.
+                # (dt is honest: np.asarray above forced the chain.)
+                keys = sum(CostModel.chunk_keys(p, 0)
+                           for _, _, p in part)
+                self._note_device_phase(
+                    "prefill",
+                    tokens=sum(p for _, _, p in part),
+                    attended_keys=keys,
+                    weight_passes=1, kv_read_tokens=keys,
+                    dt=time.monotonic() - t0)
                 for j, (slot, req, plen) in enumerate(part):
                     sl = (slice(None),) * self._sax + (slice(j, j + 1),)
                     row_slices = [{k: v[sl] for k, v in layer.items()
@@ -1413,6 +1478,11 @@ class InferenceEngine:
                 if s not in self.slot_prefill
                 and self.slot_req[s] is not None  # free rows are dead
             )
+            # device-plane accounting reads each chunk's pre-advance
+            # context; compute before the branches mutate st["done"]
+            pf_tokens = sum(len(c) for _, _, c in entries)
+            pf_keys = sum(CostModel.chunk_keys(len(c), st["done"])
+                          for _, st, c in entries)
             t0 = time.monotonic()
             if batchable:
                 tok, starts, lens = self._chunk_batch_rows(entries)
@@ -1433,8 +1503,21 @@ class InferenceEngine:
                         jnp.asarray(len(chunk), jnp.int32),
                     )
                     st["done"] += len(chunk)
-            self._trace_chunks(entries, time.monotonic() - t0,
-                               batched=batchable)
+            # force the chunks' last-logits before stamping dt: on an
+            # async backend issue time alone would inflate the prefill
+            # MFU/BW gauges ~device-time/dispatch-time-fold (the decode
+            # and fused paths force every dispatch the same way). The
+            # logits are consumed at activation regardless; KV writes
+            # land in the same program, so this waits only for work the
+            # next chunk depends on anyway.
+            jax.block_until_ready([st["last_logits"]
+                                   for _, st, _ in entries])
+            dt = time.monotonic() - t0
+            self._trace_chunks(entries, dt, batched=batchable)
+            self._note_device_phase(
+                "prefill", tokens=pf_tokens, attended_keys=pf_keys,
+                weight_passes=1 if batchable else len(entries),
+                kv_read_tokens=pf_keys, dt=dt)
             budget -= 1
             progressed = True
             self._finalize_prefills()
@@ -1535,6 +1618,7 @@ class InferenceEngine:
     def _prefill_into_slot(self, req: Request, slot: int, plen: int, hit):
         """One-shot prefill (reusing any cached prefix rows) into ``slot``;
         returns the last-position logits."""
+        t0 = time.monotonic()
         if hit is not None:
             suffix = req.prompt_ids[hit.length:]
             sbucket = self._bucket_for(len(suffix))
@@ -1544,6 +1628,7 @@ class InferenceEngine:
                 self.params, hit.rows, jnp.asarray(hit.length, jnp.int32),
                 jnp.asarray(padded), jnp.asarray(len(suffix), jnp.int32),
             )
+            new, start = len(suffix), hit.length
         else:
             bucket = self._bucket_for(plen)
             padded = np.zeros((1, bucket), np.int32)
@@ -1552,6 +1637,18 @@ class InferenceEngine:
                 self.params, jnp.asarray(padded),
                 jnp.asarray([plen], jnp.int32)
             )
+            new, start = plen, 0
+        # force + stamp dt BEFORE the insert/prefix-store work so this
+        # sample covers exactly the prefill forward, same boundary as
+        # the chunked/fused paths (async-backend honesty — see
+        # _advance_prefills); the logits feed the first-token sample on
+        # this same call path anyway
+        jax.block_until_ready(last_logits)
+        keys = CostModel.chunk_keys(new, start)
+        self._note_device_phase(
+            "prefill", tokens=new, attended_keys=keys,
+            weight_passes=1, kv_read_tokens=keys,
+            dt=time.monotonic() - t0)
         self._finish_prefill(req, slot, plen, pre_cache, last_logits)
         return last_logits
 
@@ -1650,9 +1747,23 @@ class InferenceEngine:
         tokens[:, 0] = self.slot_last_token
         for s, d in drafts.items():
             tokens[s, 1: 1 + len(d)] = d
+        t0 = time.monotonic()
         out, self.cache = self._decode_spec(
             self.params, self.cache, jnp.asarray(tokens))
         out_host = np.asarray(out)
+        # the verify is ONE wide forward over k+1 positions per slot
+        # (that width amortizing the weight read is the whole spec bet
+        # — the decode MFU gauge shows it paying off or not). Useful
+        # positions only: an undrafted/short-draft slot's zero padding
+        # is wasted work and must read as lost MFU, same convention as
+        # the spec_proposed/spec_accepted counters below.
+        useful = {s: len(drafts.get(s, ())) + 1 for s in active}
+        keys = sum(CostModel.block_keys(useful[s], int(self.slot_len[s]))
+                   for s in active)
+        self._note_device_phase(
+            "decode", tokens=sum(useful.values()), attended_keys=keys,
+            weight_passes=1, kv_read_tokens=keys,
+            dt=time.monotonic() - t0)
         delta = np.zeros((self.max_slots,), np.int32)
         for s in active:
             n_acc = 0
@@ -1771,6 +1882,17 @@ class InferenceEngine:
         tok, starts, lens = self._chunk_batch_rows(entries)
         advance = np.zeros((self.max_slots,), np.int32)
         advance[active] = n
+        # per-phase device accounting for the ONE fused dispatch: the
+        # wall time is split between prefill and decode in proportion
+        # to each half's FLOPs (token-count fallback without a cost
+        # model) — arxiv 2311.03687's phase dissection must survive the
+        # fusion that merged the phases into one program
+        pf_tokens = sum(len(c) for _, _, c in entries)
+        pf_keys = sum(CostModel.chunk_keys(len(c), st["done"])
+                      for _, st, c in entries)
+        dc_tokens = n * len(active)
+        dc_keys = sum(CostModel.block_keys(n, int(self.slot_len[s]))
+                      for s in active)
         t0 = time.monotonic()
         self.rng, sub = jax.random.split(self.rng)
         chunk_last, toks, self.cache = self._mixed(
@@ -1783,14 +1905,28 @@ class InferenceEngine:
             jnp.asarray(self._greedy),
             n=n,
         )
+        toks_host = np.asarray(toks)  # forces the dispatch's results
+        dt = time.monotonic() - t0
         self.mixed_blocks += 1
         for slot, st, chunk in entries:
             st["last_logits"] = chunk_last[slot:slot + 1]
             st["done"] += len(chunk)
-        self._trace_chunks(entries, time.monotonic() - t0,
-                           batched=True, fused=True)
+        self._trace_chunks(entries, dt, batched=True, fused=True)
+        cm = self.cost_model
+        if cm is not None:
+            pf, df = (cm.step_flops(pf_tokens, pf_keys),
+                      cm.step_flops(dc_tokens, dc_keys))
+            share = pf / (pf + df) if pf + df > 0 else 0.5
+        else:
+            share = pf_tokens / max(pf_tokens + dc_tokens, 1)
+        self._note_device_phase(
+            "prefill", tokens=pf_tokens, attended_keys=pf_keys,
+            weight_passes=1, kv_read_tokens=pf_keys, dt=dt * share)
+        self._note_device_phase(
+            "decode", tokens=dc_tokens, attended_keys=dc_keys,
+            weight_passes=n, kv_read_tokens=dc_keys, dt=dt * (1 - share))
         self._finalize_prefills()
-        self._commit_block(active, np.asarray(toks), n)
+        self._commit_block(active, toks_host, n)
 
     def _commit_block(self, active: list[int], toks_host, n: int) -> None:
         """Book an ``n``-step decode block's tokens ((B, n) host array)
@@ -1911,6 +2047,7 @@ class InferenceEngine:
                     for s in active)
         )
         if use_multi:
+            t0 = time.monotonic()
             toks, self.cache = self._decode_multi(
                 self.params, self.cache,
                 jnp.asarray(self.slot_last_token),
@@ -1921,9 +2058,17 @@ class InferenceEngine:
                 jnp.asarray(self._greedy),
                 n=n,
             )
-            self._commit_block(active, np.asarray(toks), n)
+            toks_host = np.asarray(toks)
+            keys = sum(CostModel.block_keys(n, int(self.slot_len[s]))
+                       for s in active)
+            self._note_device_phase(
+                "decode", tokens=n * len(active), attended_keys=keys,
+                weight_passes=n, kv_read_tokens=keys,
+                dt=time.monotonic() - t0)
+            self._commit_block(active, toks_host, n)
             self._update_active_stats()
             return True
+        t0 = time.monotonic()
         next_tok, self.cache = self._decode(
             self.params, self.cache,
             jnp.asarray(self.slot_last_token),
@@ -1934,6 +2079,12 @@ class InferenceEngine:
             jnp.asarray(self._greedy),
         )
         next_host = np.asarray(next_tok)
+        keys = sum(CostModel.block_keys(1, int(self.slot_len[s]))
+                   for s in active)
+        self._note_device_phase(
+            "decode", tokens=len(active), attended_keys=keys,
+            weight_passes=1, kv_read_tokens=keys,
+            dt=time.monotonic() - t0)
         for slot in active:
             self._commit_token(slot, int(next_host[slot]))
         self._update_active_stats()

@@ -42,7 +42,11 @@ from llm_in_practise_tpu.obs.trace import (
     get_tracer,
     parse_traceparent,
 )
-from llm_in_practise_tpu.serve.http_util import JsonHandler, serve_obs_get
+from llm_in_practise_tpu.serve.http_util import (
+    JsonHandler,
+    serve_obs_get,
+    serve_obs_post,
+)
 
 
 @dataclass
@@ -469,6 +473,8 @@ class Gateway:
         timeout_s: float = 120.0,
         health_check_interval_s: float = 30.0,
         tracer=None,
+        ttft_slo_s: float | None = None,
+        tpot_slo_s: float | None = None,
     ):
         self.router = router
         self.retry_policy = retry_policy
@@ -493,6 +499,42 @@ class Gateway:
         # traceparent header (and through kv_transfer_params for the
         # prefill→decode hop) — obs/trace.py, docs/observability.md
         self.tracer = tracer if tracer is not None else get_tracer()
+        # SLO goodput (obs/meter.py): output tokens priced by whether
+        # their request met the configured TTFT/TPOT SLOs — the fleet
+        # number a raw tok/s rate lies about. Thresholds come from the
+        # kwargs or LLM_TPU_TTFT_SLO_S / LLM_TPU_TPOT_SLO_S; unset =
+        # accounting off (counters stay 0). Violations are blamed on
+        # the longest request-phase span in the ring (single-process
+        # stacks see the engine's phases; cross-process degrades to the
+        # gateway's own spans or "unknown").
+        import os
+
+        from llm_in_practise_tpu.obs.meter import GoodputMeter
+
+        def _env_slo(name: str) -> float | None:
+            raw = os.environ.get(name)
+            if not raw:
+                return None
+            try:
+                return float(raw)
+            except ValueError:
+                # fail OPEN like every other optional telemetry input
+                # (bad LLM_TPU_TRACE_FILE, uncovered cost model): a
+                # typo'd SLO disables goodput, never the data plane
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "ignoring malformed %s=%r (want seconds as a "
+                    "float); SLO goodput accounting disabled for this "
+                    "threshold", name, raw)
+                return None
+
+        if ttft_slo_s is None:
+            ttft_slo_s = _env_slo("LLM_TPU_TTFT_SLO_S")
+        if tpot_slo_s is None:
+            tpot_slo_s = _env_slo("LLM_TPU_TPOT_SLO_S")
+        self.goodput = GoodputMeter(ttft_slo_s, tpot_slo_s,
+                                    tracer=self.tracer)
         # unified metrics registry: one canonical exposition renderer
         # over the live router/cache counters (obs/registry.py). Built
         # LAST — the callbacks close over attributes set above.
@@ -661,12 +703,29 @@ class Gateway:
         either way. The cache only serves non-stream requests.
         ``trace``: an incoming TraceContext (from a client traceparent
         header); ``None`` starts a fresh trace rooted here."""
+        t0 = time.monotonic()
         span = self.tracer.start_span(
             "gateway.route", parent=trace,
             model=body.get("model"), stream=bool(stream))
         try:
             status, resp = self._route(body, stream, span)
             span.set(status=status)
+            if status == 200 and self.goodput.enabled:
+                trace_id = getattr(span.context(), "trace_id", None)
+                if isinstance(resp, dict):
+                    # non-stream: only end-to-end latency is observable
+                    # here — the goodput meter applies the request-level
+                    # deadline ttft_slo + (n-1)·tpot_slo
+                    tokens = int((resp.get("usage") or {})
+                                 .get("completion_tokens") or 0)
+                    self.goodput.observe(tokens=tokens,
+                                         total_s=time.monotonic() - t0,
+                                         trace_id=trace_id)
+                else:
+                    # streaming: the SSE relay measures TTFT/TPOT on
+                    # the wire and books the request at stream close
+                    resp._goodput_t0 = t0
+                    resp._goodput_trace_id = trace_id
             return status, resp
         finally:
             # streaming success: the span closes at headers-received —
@@ -826,6 +885,14 @@ class Gateway:
             "gateway_disagg_degraded_total",
             lambda: getattr(self.router, "degraded_picks", 0),
             "picks served outside the role split")
+        # SLO goodput: tokens/requests priced by whether the request
+        # met its TTFT/TPOT SLOs, plus per-phase blame from the span
+        # ring (docs/observability.md "Device plane"). All-zero until
+        # thresholds are configured.
+        from llm_in_practise_tpu.obs.meter import register_goodput
+
+        register_goodput(reg, self.goodput,
+                         subject="routed output tokens")
 
         def per_upstream(value_of):
             def collect():
@@ -866,11 +933,14 @@ class Gateway:
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):
-                if self.path != "/v1/chat/completions":
+                if self.path not in ("/v1/chat/completions",
+                                     "/debug/profile"):
                     return self._json(404, {"error": {"message": "not found"}})
                 body, err = self._read_json()
                 if err:
                     return self._json(400, err)
+                if serve_obs_post(self, body):
+                    return None
                 stream = bool(body.get("stream"))
                 ctx = parse_traceparent(self.headers.get("traceparent"))
                 try:
@@ -886,7 +956,15 @@ class Gateway:
                 return self._json(status, resp)
 
             def _relay_sse(self, upstream_resp):
-                """Pipe the upstream SSE body through unchanged."""
+                """Pipe the upstream SSE body through unchanged.
+
+                When goodput accounting is on, the relay also measures
+                the stream ON THE WIRE: time to the first content delta
+                (client-visible TTFT) and the mean gap between deltas
+                (TPOT, approximated at delta granularity — the server
+                may merge tokens per SSE event, so the wire count is a
+                lower bound on tokens and the gap an upper bound on
+                TPOT: conservative in the SLO's favor)."""
                 self._responded = True
                 self.send_response(200)
                 self.send_header(
@@ -897,17 +975,43 @@ class Gateway:
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
                 self.end_headers()
+                t0 = getattr(upstream_resp, "_goodput_t0", None)
+                first = last = None
+                n_deltas = 0
+                marker = b'"content"'
+                tail = b""   # carry len(marker)-1 bytes across reads so
+                # a marker straddling a 4096-byte read boundary still
+                # counts (a missed FIRST delta would book one full
+                # inter-token gap into TTFT — a false SLO violation)
                 try:
                     while True:
                         chunk = upstream_resp.read(4096)
                         if not chunk:
                             break
+                        if t0 is not None:
+                            hay = tail + chunk
+                            hits = hay.count(marker)
+                            tail = hay[-(len(marker) - 1):]
+                            if hits:
+                                now = time.monotonic()
+                                if first is None:
+                                    first = now
+                                last = now
+                                n_deltas += hits
                         self.wfile.write(chunk)
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 finally:
                     upstream_resp.close()
+                    if t0 is not None and first is not None:
+                        tpot = ((last - first) / (n_deltas - 1)
+                                if n_deltas > 1 else None)
+                        gw.goodput.observe(
+                            tokens=n_deltas, ttft_s=first - t0,
+                            tpot_s=tpot,
+                            trace_id=getattr(upstream_resp,
+                                             "_goodput_trace_id", None))
 
         return Handler
 

@@ -66,3 +66,54 @@ def serve_obs_get(handler: JsonHandler, metrics_text, tracer=None) -> bool:
         handler._json(200, tracer.debug_payload())
         return True
     return False
+
+
+def obs_profile_response(body: dict | None) -> tuple[int, dict]:
+    """Handle a ``POST /debug/profile`` body → ``(status, payload)``.
+
+    Body: ``{"duration_s": <float, default 2, clamped to the capture's
+    bound>}``. One capture at a time process-wide — a concurrent
+    request gets a 409. Success payload carries the capture directory
+    and the Perfetto-loadable files (see obs/prof.py); failures are
+    contained to this response (a broken profiler must never take a
+    server down). Shared by the JsonHandler servers (via
+    :func:`serve_obs_post`) and the cache service's tuple-returning
+    ``handle`` dispatch."""
+    from llm_in_practise_tpu.obs.prof import ProfilerBusyError, get_profiler
+
+    body = body or {}
+    if not isinstance(body, dict):
+        # a JSON list/string parses fine upstream; .get() on it would
+        # be an AttributeError that kills the handler thread instead of
+        # this 422 (the "failures contained to this response" contract)
+        return 422, {"error": {"message": "body must be a JSON object",
+                               "type": "invalid_request_error"}}
+    try:
+        duration = float(body.get("duration_s", 2.0))
+    except (TypeError, ValueError):
+        return 422, {"error": {"message": "duration_s must be a number",
+                               "type": "invalid_request_error"}}
+    try:
+        result = get_profiler().capture(duration)
+    except ProfilerBusyError as e:
+        return 409, {"error": {"message": str(e),
+                               "type": "conflict_error",
+                               "code": "profile_busy"}}
+    except Exception as e:  # noqa: BLE001 — profiler faults (unsupported
+        # backend, full disk) answer the curl, never crash the server
+        return 500, {"error": {"message": f"{type(e).__name__}: {e}",
+                               "type": "internal_error",
+                               "code": "profile_failed"}}
+    return 200, result
+
+
+def serve_obs_post(handler: JsonHandler, body: dict | None) -> bool:
+    """Serve the observability POST route every server exposes —
+    ``POST /debug/profile`` (bounded on-demand ``jax.profiler``
+    capture; docs/observability.md "Device plane"). Returns True when
+    the path was handled."""
+    if handler.path != "/debug/profile":
+        return False
+    status, payload = obs_profile_response(body)
+    handler._json(status, payload)
+    return True

@@ -403,7 +403,7 @@ class KVPoolServer:
         import http.server
 
         from llm_in_practise_tpu.serve.http_util import (
-            JsonHandler, serve_obs_get,
+            JsonHandler, serve_obs_get, serve_obs_post,
         )
 
         pool = self
@@ -414,6 +414,15 @@ class KVPoolServer:
                 # /debug/traces is part of every server's contract —
                 # and colocated stacks DO share the process tracer
                 if not serve_obs_get(self, pool.metrics_text):
+                    self._json(404, {"error": {"message": "not found"}})
+
+            def do_POST(self):
+                # POST /debug/profile — same contract as the rest of
+                # the stack (colocated engines show up in the capture)
+                body, err = self._read_json()
+                if err:
+                    return self._json(400, err)
+                if not serve_obs_post(self, body):
                     self._json(404, {"error": {"message": "not found"}})
 
         class Server(http.server.ThreadingHTTPServer):

@@ -24,7 +24,11 @@ from dataclasses import dataclass, field
 from http.server import ThreadingHTTPServer
 
 from llm_in_practise_tpu.obs.registry import Registry
-from llm_in_practise_tpu.serve.http_util import JsonHandler, serve_obs_get
+from llm_in_practise_tpu.serve.http_util import (
+    JsonHandler,
+    serve_obs_get,
+    serve_obs_post,
+)
 
 # Llama-Guard-3 hazard taxonomy → OpenAI moderation categories
 # (openai_moderation_map.py behavior).
@@ -139,6 +143,11 @@ class ModerationService:
                 if svc.api_key and self.headers.get("X-API-KEY") != svc.api_key:
                     return self._json(401, {"error": {"message": "invalid API key"}})
                 if self.path != "/v1/moderations":
+                    body, err = self._read_json()
+                    if err:
+                        return self._json(400, err)
+                    if serve_obs_post(self, body):
+                        return None
                     return self._json(404, {"error": {"message": "not found"}})
                 body, err = self._read_json()
                 if err:

@@ -1,0 +1,42 @@
+"""Metric/doc drift gate as a tier-1 test (tools/check_metric_docs.py).
+
+Constructs the serving stack's default registries (every conditional
+family forced on) and fails when any registered family is missing from
+the docs/observability.md catalog — a new metric without its doc row,
+or a doc row whose name drifted from the code, can't land.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools import check_metric_docs  # noqa: E402
+
+
+def test_every_registered_family_is_documented():
+    missing = check_metric_docs.check()
+    assert not missing, (
+        "metric families registered in code but missing from "
+        f"docs/observability.md: {missing} — add a catalog row for "
+        "each (see tools/check_metric_docs.py)")
+
+
+def test_doc_pattern_notation():
+    pats = check_metric_docs.doc_patterns(
+        "| `llm_cache_{exact_hits,misses}_total` | counter |\n"
+        "`llm_handoff_total{event=…}` and `llm_prefix_cache_*`\n"
+        "```promql\nrate(llm_fenced_total[5m])\n```\n")
+    assert "llm_cache_exact_hits_total" in pats
+    assert "llm_cache_misses_total" in pats
+    assert "llm_handoff_total" in pats          # label selector stripped
+    assert "llm_prefix_cache_*" in pats         # glob survives
+    assert "llm_fenced_total" in pats           # fenced blocks count
+    assert not check_metric_docs.check(
+        registered={"llm_cache_misses_total", "llm_prefix_cache_hits"},
+        md_text="`llm_cache_{exact_hits,misses}_total` "
+                "`llm_prefix_cache_*`")
+    assert check_metric_docs.check(
+        registered={"llm_undocumented_total"},
+        md_text="nothing here") == ["llm_undocumented_total"]

@@ -1,0 +1,174 @@
+"""Metric/doc drift gate: every registered family must be in the docs.
+
+Constructs the serving stack's default registries (model server with
+every conditional family enabled, gateway, cache service, kv-pool,
+moderation), walks every family name registered in
+``obs/registry.py``'s process-wide census, and fails when one is
+missing from the ``docs/observability.md`` catalog. PR 3 hand-audited
+that catalog once; this tool makes the audit a tier-1 test
+(``tests/test_metric_docs.py``) so a new family without its doc row —
+or a doc row whose name drifted from the code — can't land again.
+
+Doc-side matching understands the catalog's notation: backtick code
+spans, ``{a,b,c}`` brace alternation
+(``llm_cache_{exact_hits,misses}_total``), trailing label selectors
+(``llm_handoff_total{event=…}``), and ``*`` globs
+(``llm_prefix_cache_*``).
+
+Run standalone: ``python tools/check_metric_docs.py`` (rc 1 on drift).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import itertools
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DOC = os.path.join(REPO, "docs", "observability.md")
+
+_CODE_SPAN = re.compile(r"`([^`]+)`")
+_NAME_TOKEN = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:{},*]*")
+
+
+def doc_patterns(md_text: str) -> set[str]:
+    """Metric-name patterns declared by the doc's code spans (and the
+    bodies of fenced ```promql blocks — a family referenced only from
+    an example query still counts as documented)."""
+    spans: list[str] = []
+    in_fence = False
+    for line in md_text.split("\n"):
+        if line.lstrip().startswith("```"):
+            # fences toggle; pairing ` across a fence line would skew
+            # every span after it (the bug a whole-file regex has)
+            in_fence = not in_fence
+            continue
+        if in_fence:
+            spans.append(line)
+        else:
+            spans.extend(_CODE_SPAN.findall(line))
+    out: set[str] = set()
+    for span in spans:
+        for token in _NAME_TOKEN.findall(span):
+            # drop a trailing label selector: name{event=…} -> name
+            # (the token regex stops at '=' so the brace never closes;
+            # brace ALTERNATION closes inside the token and expands)
+            if "{" in token:
+                head, brace = token.split("{", 1)
+                if "}" not in brace or "=" in brace:
+                    token = head
+            if not token:
+                continue
+            out.update(_expand_braces(token))
+    return out
+
+
+def _expand_braces(token: str) -> list[str]:
+    """``a_{x,y}_b`` -> [``a_x_b``, ``a_y_b``] (multiple groups too)."""
+    parts: list[list[str]] = []
+    rest = token
+    while "{" in rest:
+        head, rest = rest.split("{", 1)
+        if "}" not in rest:      # malformed span: treat literally
+            return [token.replace("{", "").replace("}", "")]
+        group, rest = rest.split("}", 1)
+        parts.append([head])
+        parts.append(group.split(","))
+    parts.append([rest])
+    return ["".join(combo) for combo in itertools.product(*parts)]
+
+
+def collect_registered() -> frozenset[str]:
+    """Construct the stack's default registries (conditional families
+    forced ON) and return the union of their family names."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
+    from llm_in_practise_tpu.serve.api import OpenAIServer
+    from llm_in_practise_tpu.serve.cache_service import CacheService
+    from llm_in_practise_tpu.serve.engine import InferenceEngine
+    from llm_in_practise_tpu.serve.gateway import (
+        Gateway, ResponseCache, Router, Upstream,
+    )
+    from llm_in_practise_tpu.serve.kv_pool import KVPoolServer
+    from llm_in_practise_tpu.serve.moderation import ModerationService
+
+    class _Tok:
+        def encode(self, text):
+            return list(text.encode()[:32])
+
+        def decode(self, ids):
+            return bytes(int(i) % 256 for i in ids).decode(
+                "utf-8", "replace")
+
+    cfg = GPTConfig(vocab_size=256, seq_len=64, n_layer=1, n_head=2,
+                    embed_dim=16, dropout=0.0, pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    # every conditional family ON: prefix cache, speculation,
+    # multi-step decode — their metric families must be documented too
+    engine = InferenceEngine(model, params, max_slots=2, cache_len=64,
+                             cache_dtype=jnp.float32, prefix_cache=True,
+                             speculative_k=2, decode_steps=2)
+    owners = [
+        OpenAIServer(engine, _Tok(), model_name="census"),
+        Gateway(Router([Upstream("http://127.0.0.1:1", "census",
+                                 group="census")]),
+                cache=ResponseCache(semantic_threshold=None),
+                health_check_interval_s=0),
+        CacheService(),
+        ModerationService(),
+        KVPoolServer(),     # registry built in __init__; never started
+    ]
+    engine.stop()
+    names: set[str] = set()
+    for owner in owners:
+        reg = getattr(owner, "registry", None)
+        if reg is None:          # moderation builds its registry lazily
+            owner.metrics_text()
+            reg = owner._registry
+        names |= reg.family_names()
+    return frozenset(names)
+
+
+def check(registered=None, md_text: str | None = None) -> list[str]:
+    """Families registered but absent from the doc catalog (sorted)."""
+    if registered is None:
+        registered = collect_registered()
+    if md_text is None:
+        with open(DOC, encoding="utf-8") as f:
+            md_text = f.read()
+    patterns = doc_patterns(md_text)
+    missing = []
+    for name in sorted(registered):
+        if name in patterns:
+            continue
+        if any("*" in p and fnmatch.fnmatch(name, p) for p in patterns):
+            continue
+        missing.append(name)
+    return missing
+
+
+def main() -> int:
+    missing = check()
+    if missing:
+        print("metric families registered in code but MISSING from "
+              f"{os.path.relpath(DOC, REPO)}:")
+        for name in missing:
+            print(f"  - {name}")
+        print("add a catalog row (docs/observability.md) for each, or "
+              "fix the drifted name.")
+        return 1
+    print(f"OK: every registered metric family is documented in "
+          f"{os.path.relpath(DOC, REPO)}.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,14 @@ import numpy as np
 import optax
 
 from llm_in_practise_tpu.models.gpt import GPT, gptlike_config
+# the ONE FLOP/peak model (obs/cost.py) — this probe used to hand-roll
+# a 6·N estimate against a hard-coded v5e peak, drifting from the
+# audited accounting every other consumer divides by
+from llm_in_practise_tpu.obs.cost import (
+    chip_peak,
+    flops_per_token,
+    matmul_param_count,
+)
 from llm_in_practise_tpu.train.step import make_train_step
 from llm_in_practise_tpu.parallel import strategy as S
 from llm_in_practise_tpu.core import mesh as mesh_lib
@@ -65,9 +73,12 @@ with mesh:
     dt_c = (time.perf_counter() - t0) / ITERS
 
 tok = BATCH * SEQ
-flop_step = 6 * n_params * tok + 12 * cfg.n_layer * SEQ * cfg.embed_dim * tok
+m = matmul_param_count(state.params, tied_head=cfg.tie_weights)
+flop_step = flops_per_token(m, cfg.n_layer, SEQ, cfg.embed_dim,
+                            train_full=True) * tok
+_, peak = chip_peak()
 for name, dt in (("block_until_ready", dt_a), ("float-after", dt_b),
                  ("float-every-step", dt_c)):
-    mfu = flop_step / dt / 197e12
+    mfu = flop_step / dt / peak
     print(f"{name:20s} {dt*1e3:9.2f} ms/step  {tok/dt:12.0f} tok/s  "
           f"implied MFU {mfu*100:7.1f}%")

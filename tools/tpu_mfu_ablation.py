@@ -38,6 +38,14 @@ import numpy as np
 import optax
 
 import bench
+# the ONE FLOP/peak model (obs/cost.py) — bench re-exports it, but the
+# tools import the source of truth directly so a bench refactor can't
+# silently fork the accounting again
+from llm_in_practise_tpu.obs.cost import (
+    chip_peak,
+    flops_per_token,
+    matmul_param_count,
+)
 from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
 from llm_in_practise_tpu.peft import lora as lora_lib
 from llm_in_practise_tpu.peft.qlora import make_qlora_loss_fn_args
@@ -92,10 +100,10 @@ def build_step(*, quantized: bool, attn_impl: str = "auto",
     def qstep(lora, opt_state, batch, rng):
         return step4(lora, opt_state, base, batch, rng)
 
-    m = bench.matmul_param_count(abstract, tied_head=True)
-    f_tok = bench.flops_per_token(m, cfg.n_layer, SEQ,
-                                  cfg.n_head * cfg.head_dim,
-                                  train_full=False)
+    m = matmul_param_count(abstract, tied_head=True)
+    f_tok = flops_per_token(m, cfg.n_layer, SEQ,
+                            cfg.n_head * cfg.head_dim,
+                            train_full=False)
     return qstep, lora, opt_state, f_tok
 
 
@@ -132,7 +140,7 @@ def time_variant(name: str, peak: float, **kw) -> dict:
 
 
 def main() -> None:
-    kind, peak = bench.chip_peak()
+    kind, peak = chip_peak()
     print(f"device {kind}", flush=True)
     rows = [
         time_variant("full", peak, quantized=True),

@@ -52,13 +52,22 @@ def main() -> None:
 
     enable_compilation_cache()
     import bench
-    from bench import G14B, _distinct_base_stacked, _hbm_stats
+    from bench import G14B, _distinct_base_stacked
+    # the ONE FLOP/peak model — imported from its home (obs/cost.py),
+    # not re-derived: the r4 era's hand-copied variant of the per-token
+    # formula is exactly the drift this import kills
+    from llm_in_practise_tpu.obs.cost import (
+        chip_peak,
+        flops_per_token,
+        hbm_stats as _hbm_stats,
+        matmul_param_count,
+    )
     from llm_in_practise_tpu.models.qwen3 import Qwen3, Qwen3Config
     from llm_in_practise_tpu.peft import lora as lora_lib
     from llm_in_practise_tpu.peft.fused import make_fused_qlora_loss_fn_args
     from llm_in_practise_tpu.train.losses import fused_linear_cross_entropy
 
-    kind, peak = bench.chip_peak()
+    kind, peak = chip_peak()
     print(f"device {kind}", flush=True)
 
     base_cfg = Qwen3Config(
@@ -75,10 +84,10 @@ def main() -> None:
         lambda r: Qwen3(base_cfg).init(
             r, jnp.ones((1, 8), jnp.int32))["params"],
         jax.random.PRNGKey(0))
-    m = bench.matmul_param_count(abstract, tied_head=True)
-    f_tok = bench.flops_per_token(m, base_cfg.n_layer, SEQ,
-                                  base_cfg.n_head * base_cfg.head_dim,
-                                  train_full=False)
+    m = matmul_param_count(abstract, tied_head=True)
+    f_tok = flops_per_token(m, base_cfg.n_layer, SEQ,
+                            base_cfg.n_head * base_cfg.head_dim,
+                            train_full=False)
     lcfg = lora_lib.LoRAConfig(r=8, alpha=16.0,
                                target_patterns=("q_proj", "v_proj"))
 

@@ -97,6 +97,12 @@ def main() -> None:
                             n_requests=max(8, conc), max_tokens=4)
     print(f"warmup/compile {time.perf_counter()-t0:.0f}s", flush=True)
 
+    # SLO goodput accounting from here on (post-warmup, so first-use
+    # compiles don't count as violations): the artifact's device-plane
+    # block then splits output tokens into slo=ok vs slo=violated
+    engine.stats.goodput.configure(SLA["ttft_p99_ms"] / 1e3,
+                                   SLA["tpot_p99_ms"] / 1e3)
+
     inproc_levels = []
     for conc in LADDER:
         r = run_level_inprocess(engine, prompt_ids, concurrency=conc,
@@ -139,12 +145,14 @@ def main() -> None:
         print(json.dumps(r), flush=True)
 
     # observability snapshot BEFORE shutdown: the /metrics exposition
-    # (dispatch accounting, TTFT/TPOT histograms) and the trace-ring
-    # summary ride in the artifact, so a perf regression in these rows
-    # arrives with its per-phase breakdown attached (bench.obs_snapshot)
+    # (dispatch accounting, TTFT/TPOT histograms), the trace-ring
+    # summary, AND the device plane (per-phase MFU / HBM-bandwidth
+    # utilization, peak HBM, compile seconds, SLO goodput) ride in the
+    # artifact, so a perf regression in these rows arrives with its
+    # per-phase breakdown attached (bench.obs_snapshot)
     from bench import obs_snapshot
 
-    observability = obs_snapshot(server=srv)
+    observability = obs_snapshot(server=srv, engine=engine)
 
     srv.shutdown()  # also stops the engine thread it owns
     artifact = {

@@ -315,6 +315,10 @@ def main() -> None:
 
     engine.queue_timeout_s = QUEUE_TIMEOUT_S or None
     engine.max_queue = MAX_QUEUE
+    # SLO goodput from here on (post-warmup/post-cold-warm probes): the
+    # artifact's device-plane block splits served tokens by SLO outcome
+    engine.stats.goodput.configure(SLA["ttft_p99_ms"] / 1e3,
+                                   SLA["tpot_p99_ms"] / 1e3)
     levels = []
     for conc in LADDER:
         r = run_level_inprocess(engine, prompt_ids, concurrency=conc,
@@ -339,9 +343,11 @@ def main() -> None:
 
     engine.stop()
     artifact = {
-        # trace-ring summary (per-phase span counts/seconds): the
-        # latency breakdown that turns a regressed row into a diagnosis
-        "observability": obs_snapshot(),
+        # trace-ring summary (per-phase span counts/seconds) + device
+        # plane (per-phase MFU / HBM-bandwidth utilization, peak HBM,
+        # compile seconds, goodput): the breakdown that turns a
+        # regressed row into a diagnosis
+        "observability": obs_snapshot(engine=engine),
         "device": jax.devices()[0].device_kind,
         "model": f"Qwen3-arch d{cfg.hidden_size}/L{n_layer}, vocab "
                  f"151936, distinct-per-layer {FMT.upper()}, "
