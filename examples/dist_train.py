@@ -56,7 +56,6 @@ def main():
     import jax
 
     from llm_in_practise_tpu.data import (
-        BPETokenizer,
         block_chunk,
         prepare_data,
         tokenize_corpus,

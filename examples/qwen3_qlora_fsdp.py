@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from llm_in_practise_tpu.ckpt import checkpoint as ckpt
 from llm_in_practise_tpu.core import mesh as mesh_lib
-from llm_in_practise_tpu.data import BPETokenizer, build_sft_dataset
+from llm_in_practise_tpu.data import build_sft_dataset
 from llm_in_practise_tpu.data.sft import IGNORE_INDEX, self_cognition_records
 from llm_in_practise_tpu.models import Qwen3, qwen3_config
 from llm_in_practise_tpu.peft import (
@@ -36,7 +36,6 @@ from llm_in_practise_tpu.peft import (
     init_lora,
     make_qlora_loss_fn_args,
     memory_report,
-    qlora_apply,
     quantize_base,
     trainable_report,
 )

@@ -198,20 +198,36 @@ class HandoffMeter:
     first-order health signal (lost handoffs mean the decode pool is
     paying for prefill again).
 
-    Plain int increments under the GIL — same contract as the engine's
-    own counters (scrapers read a near-current snapshot)."""
+    Incremented from concurrent HTTP handler threads (every decode
+    replica's ``/v1/chat/completions`` claims here), so the
+    read-modify-write increments hold a lock — unlike the engine's
+    single-writer counters, two handler threads CAN interleave a
+    ``+= 1`` and lose a count (the unguarded-counter class graftlint's
+    ``guarded-by`` pass exists for). Scrapers still read the plain
+    attributes lock-free (GIL-atomic reads of monotone ints)."""
 
     def __init__(self):
-        self.claimed = 0        # handoff ids that resolved to an entry
-        self.lost = 0           # ids that resolved to nothing → re-prefill
-        self.repinned = 0       # entries re-published after a local shed
-        self.repin_failed = 0   # ...and re-pins that could not land
+        self._lock = threading.Lock()
+        self.claimed = 0        # guarded-by: _lock — ids that resolved
+        self.lost = 0           # guarded-by: _lock — nothing → re-prefill
+        self.repinned = 0       # guarded-by: _lock — re-published sheds
+        self.repin_failed = 0   # guarded-by: _lock — re-pins that failed
 
     def claim_outcome(self, entry_found: bool) -> None:
-        if entry_found:
-            self.claimed += 1
-        else:
-            self.lost += 1
+        with self._lock:
+            if entry_found:
+                self.claimed += 1
+            else:
+                self.lost += 1
+
+    def note_repin(self, ok: bool) -> None:
+        """Book a shed request's handoff-entry re-pin (api.py's
+        queue-full path runs on concurrent handler threads)."""
+        with self._lock:
+            if ok:
+                self.repinned += 1
+            else:
+                self.repin_failed += 1
 
 
 class GoodputMeter:
@@ -251,11 +267,11 @@ class GoodputMeter:
         self.tpot_slo_s = tpot_slo_s
         self.tracer = tracer
         self._lock = threading.Lock()
-        self.tokens_ok = 0
-        self.tokens_violated = 0
-        self.requests_ok = 0
-        self.requests_violated = 0
-        self.blame: dict[str, int] = {}
+        self.tokens_ok = 0           # guarded-by: _lock
+        self.tokens_violated = 0     # guarded-by: _lock
+        self.requests_ok = 0         # guarded-by: _lock
+        self.requests_violated = 0   # guarded-by: _lock
+        self.blame: dict[str, int] = {}  # guarded-by: _lock
 
     def configure(self, ttft_slo_s: float | None = None,
                   tpot_slo_s: float | None = None) -> "GoodputMeter":
@@ -333,16 +349,25 @@ def register_goodput(registry, meter: GoodputMeter, *,
     (``llm_goodput_tokens_total`` / ``llm_slo_requests_total`` /
     ``llm_slo_blame_total``; docs/observability.md "Device plane").
     ``registry`` is any object with ``counter_func`` (obs.registry).
-    All-zero until the meter's thresholds are configured."""
+    All-zero until the meter's thresholds are configured.
+
+    Every family reads through :meth:`GoodputMeter.snapshot` (one lock
+    acquisition per collect): the ok/violated pair of a family comes
+    from ONE consistent view, so a scrape can never render an ok count
+    from before an observe and a violated count from after it (the
+    scrape-callback-vs-writer torn read the lock-discipline pass
+    flags)."""
     registry.counter_func(
         "llm_goodput_tokens_total",
-        lambda: [({"slo": "ok"}, meter.tokens_ok),
-                 ({"slo": "violated"}, meter.tokens_violated)],
+        lambda: [
+            ({"slo": "ok"}, (s := meter.snapshot())["tokens_ok"]),
+            ({"slo": "violated"}, s["tokens_violated"])],
         f"{subject} by SLO outcome of their request")
     registry.counter_func(
         "llm_slo_requests_total",
-        lambda: [({"slo": "ok"}, meter.requests_ok),
-                 ({"slo": "violated"}, meter.requests_violated)],
+        lambda: [
+            ({"slo": "ok"}, (s := meter.snapshot())["requests_ok"]),
+            ({"slo": "violated"}, s["requests_violated"])],
         "finished requests by SLO outcome")
     registry.counter_func(
         "llm_slo_blame_total",

@@ -49,8 +49,12 @@ class ProfilerCapture:
                          or os.path.join(tempfile.gettempdir(),
                                          f"llm_tpu_profile_{os.getpid()}"))
         self._lock = threading.Lock()
-        self.captures = 0
-        self.busy_rejections = 0
+        # counter lock, NOT the capture lock: busy rejections happen
+        # exactly when _lock could not be acquired, and concurrent 409s
+        # racing a bare `+= 1` would lose counts
+        self._stats_lock = threading.Lock()
+        self.captures = 0           # guarded-by: _stats_lock
+        self.busy_rejections = 0    # guarded-by: _stats_lock
 
     def capture(self, duration_s: float = 2.0) -> dict:
         """Record ``duration_s`` (clamped to [MIN, MAX]) of device
@@ -60,7 +64,8 @@ class ProfilerCapture:
         duration = min(max(float(duration_s), self.MIN_DURATION_S),
                        self.MAX_DURATION_S)
         if not self._lock.acquire(blocking=False):
-            self.busy_rejections += 1
+            with self._stats_lock:
+                self.busy_rejections += 1
             raise ProfilerBusyError(
                 "a profiler capture is already in progress — retry when "
                 "it finishes (captures are bounded at "
@@ -74,15 +79,18 @@ class ProfilerCapture:
             # its hot loop) makes our profile_trace degrade to a no-op
             # — that must be a 409, never a 200 with an empty capture
             if meter._profile_lock.locked():
-                self.busy_rejections += 1
+                with self._stats_lock:
+                    self.busy_rejections += 1
                 raise ProfilerBusyError(
                     "a jax.profiler trace is already active in this "
                     "process (profile_trace around a hot loop?) — "
                     "retry when it finishes")
+            with self._stats_lock:
+                n_prior = self.captures
             out_dir = os.path.join(
                 self.base_dir,
                 time.strftime("capture-%Y%m%d-%H%M%S")
-                + f"-{self.captures}")
+                + f"-{n_prior}")
             os.makedirs(out_dir, exist_ok=True)
             with meter.profile_trace(out_dir):
                 time.sleep(duration)
@@ -97,7 +105,8 @@ class ProfilerCapture:
                 raise ProfilerBusyError(
                     "capture produced no trace — a concurrent "
                     "jax.profiler trace was active; retry")
-            self.captures += 1
+            with self._stats_lock:
+                self.captures += 1
             return {
                 "trace_dir": out_dir,
                 "duration_s": duration,
@@ -136,8 +145,8 @@ class CompileMeter:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.compile_events = 0
-        self.compile_seconds = 0.0
+        self.compile_events = 0      # guarded-by: _lock
+        self.compile_seconds = 0.0   # guarded-by: _lock
 
     def note(self, seconds: float) -> None:
         with self._lock:

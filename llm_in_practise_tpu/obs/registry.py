@@ -93,9 +93,10 @@ class HistogramAccumulator:
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket bound")
-        self._counts = [0] * (len(self.buckets) + 1)  # +1 = overflow bin
-        self.count = 0
-        self.sum = 0.0
+        # +1 = overflow bin
+        self._counts = [0] * (len(self.buckets) + 1)  # guarded-by: _lock
+        self.count = 0    # guarded-by: _lock
+        self.sum = 0.0    # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -188,7 +189,7 @@ class _ChildFamily(_Family):
     def __init__(self, name, kind, help="", labelnames=()):
         super().__init__(name, kind, help)
         self.labelnames = tuple(labelnames)
-        self._children: dict[tuple, object] = {}
+        self._children: dict[tuple, object] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         if not self.labelnames:
             # eager unlabeled child: a histogram scraped before its
@@ -286,7 +287,7 @@ class Registry:
     """A set of metric families with one canonical text renderer."""
 
     def __init__(self):
-        self._families: list[_Family] = []
+        self._families: list[_Family] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def register(self, family: _Family):

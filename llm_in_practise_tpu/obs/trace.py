@@ -191,15 +191,15 @@ class Tracer:
             enabled = os.environ.get("LLM_TPU_TRACE", "").lower() not in (
                 "off", "0", "false")
         self.enabled = enabled
-        self._ring: deque[Span] = deque(maxlen=capacity)
+        self._ring: deque[Span] = deque(maxlen=capacity)  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.spans_recorded = 0
+        self.spans_recorded = 0  # guarded-by: _lock
         # the JSONL sink has its own lock: a slow disk must serialize
         # only the writers, never the ring appends (engine loop) or the
         # ring reads (/debug/traces scrapes) behind file I/O
         self._file_lock = threading.Lock()
-        self._file = None
-        self._file_path = None
+        self._file = None        # guarded-by: _file_lock
+        self._file_path = None   # guarded-by: _file_lock
         if trace_file:
             self.set_trace_file(trace_file)
 
@@ -242,7 +242,10 @@ class Tracer:
         with self._lock:
             self.spans_recorded += 1
             self._ring.append(span)
-        if self._file is None:
+        # racy-but-rechecked fast path: most deployments have no sink,
+        # and a stale read here only costs one serialize-or-skip — the
+        # authoritative check runs under the lock below
+        if self._file is None:  # graftlint: disable=guarded-by
             return
         line = json.dumps(_chrome_event(span)) + "\n"
         with self._file_lock:

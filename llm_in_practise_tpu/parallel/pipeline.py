@@ -27,8 +27,6 @@ the GPT family, and :func:`pipeline_strategy` returning the mesh spec.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map

@@ -157,7 +157,9 @@ class OpenAIServer:
                 pooled = (h * mask).sum(axis=1) / jnp.maximum(length, 1)
                 return pooled[0].astype(jnp.float32)
 
-            embed_fn = self._embed_fns[id(engine)] = jax.jit(embed)
+            # lazily built ONCE per engine and cached in self._embed_fns
+            # (checked above) — later requests reuse the compiled pooler
+            embed_fn = self._embed_fns[id(engine)] = jax.jit(embed)  # graftlint: disable=jit-in-handler
 
         data, total = [], 0
         for i, item in enumerate(inputs):
@@ -336,7 +338,7 @@ class OpenAIServer:
                     except Exception as e:  # noqa: BLE001 — the retry will
                         # degrade to a local prefill; leave a trace of where
                         # the entry went (silent loss is undebuggable)
-                        self.handoff_meter.repin_failed += 1
+                        self.handoff_meter.note_repin(False)
                         from llm_in_practise_tpu.obs.logging import get_logger
 
                         get_logger("serve.api").warning(
@@ -344,7 +346,7 @@ class OpenAIServer:
                             "%s); the retry will re-prefill",
                             xfer["handoff_id"], type(e).__name__, e)
                     else:
-                        self.handoff_meter.repinned += 1
+                        self.handoff_meter.note_repin(True)
                 span.end(status=429, finish_reason="queue_full")
                 return send_json(429, {"error": {
                     "message": message + " — retry later or against "
@@ -676,20 +678,27 @@ class OpenAIServer:
                 if serve_obs_get(self, server.metrics_text,
                                  server.tracer):
                     return
-                if self.path == "/v1/models":
-                    return self._json(200, {
-                        "object": "list",
-                        "data": [{
-                            "id": name,
-                            "object": "model",
-                            "owned_by": "llm-in-practise-tpu",
-                        } for name in (server.model_name, *server.adapters)],
-                    })
-                if self.path in ("/", "/chat"):
-                    return self._text(
-                        200, webui_html(server.model_name).encode(),
-                        "text/html; charset=utf-8",
-                    )
+                try:
+                    if self.path == "/v1/models":
+                        return self._json(200, {
+                            "object": "list",
+                            "data": [{
+                                "id": name,
+                                "object": "model",
+                                "owned_by": "llm-in-practise-tpu",
+                            } for name in (server.model_name,
+                                           *server.adapters)],
+                        })
+                    if self.path in ("/", "/chat"):
+                        return self._text(
+                            200, webui_html(server.model_name).encode(),
+                            "text/html; charset=utf-8",
+                        )
+                except Exception as e:  # noqa: BLE001 — a GET fault must
+                    # answer the client, not drop the connection
+                    return self._json(500, {"error": {
+                        "message": f"{type(e).__name__}: {e}",
+                        "type": "internal_error"}})
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):

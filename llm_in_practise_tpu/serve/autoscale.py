@@ -100,16 +100,19 @@ class ReplicaAutoscaler:
         self._router_lock = router.__dict__.setdefault(
             "_membership_lock", threading.Lock())
         # (ts, ongoing) samples inside the look-back window
-        self._samples: "deque[tuple[float, float]]" = deque()
-        self._want_up_since: float | None = None
-        self._want_down_since: float | None = None
-        self._draining: list[Upstream] = []
+        self._samples: "deque[tuple[float, float]]" = deque()  # guarded-by: _lock
+        self._want_up_since: float | None = None    # guarded-by: _lock
+        self._want_down_since: float | None = None  # guarded-by: _lock
+        self._draining: list[Upstream] = []         # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop_event = threading.Event()
         self._thread: threading.Thread | None = None
-        self.upscales = 0
-        self.downscales = 0
-        self.errors = 0
+        # decision counters: written by the controller thread, read by
+        # scrapes/tests from other threads — and tick() is callable
+        # directly (tests, manual control), so increments hold the lock
+        self.upscales = 0     # guarded-by: _lock
+        self.downscales = 0   # guarded-by: _lock
+        self.errors = 0       # guarded-by: _lock
 
     # -- observability --------------------------------------------------------
 
@@ -120,6 +123,14 @@ class ReplicaAutoscaler:
                      or getattr(u, "role", "both") == self.role)]
 
     def ongoing(self) -> int:
+        """Current in-flight count (public: tests/metrics callers).
+        Takes the state lock — ``tick`` holds it already and uses
+        :meth:`_ongoing_locked` (reading ``_draining`` lock-free here
+        would race tick's drain-list mutation)."""
+        with self._lock:
+            return self._ongoing_locked()
+
+    def _ongoing_locked(self) -> int:
         # draining victims left the router but their in-flight requests are
         # still load — excluding them would bias the mean downward during
         # every drain and trigger cascading downscales
@@ -128,7 +139,7 @@ class ReplicaAutoscaler:
 
     # -- the control law ------------------------------------------------------
 
-    def _mean_ongoing(self, now: float) -> float:
+    def _mean_ongoing_locked(self, now: float) -> float:
         cfg = self.config
         while self._samples and now - self._samples[0][0] > cfg.look_back_period_s:
             self._samples.popleft()
@@ -156,10 +167,10 @@ class ReplicaAutoscaler:
                 if u.pending == 0:
                     self._draining.remove(u)
                     to_stop.append(u)
-            self._samples.append((now, float(self.ongoing())))
+            self._samples.append((now, float(self._ongoing_locked())))
             current = len(self.replicas())
             desired = math.ceil(
-                self._mean_ongoing(now) / cfg.target_ongoing_requests)
+                self._mean_ongoing_locked(now) / cfg.target_ongoing_requests)
             desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
 
             if desired > current:
@@ -199,7 +210,9 @@ class ReplicaAutoscaler:
         # -- callbacks, outside the lock --
         for u in to_stop:
             self.stop(u)
-        self.downscales += len(to_stop)
+        if to_stop:
+            with self._lock:
+                self.downscales += len(to_stop)
         fresh: list[Upstream] = []
         if n_spawn:
             try:
@@ -225,7 +238,8 @@ class ReplicaAutoscaler:
                 if fresh:
                     with self._router_lock:
                         self.router.upstreams = self.router.upstreams + fresh
-                    self.upscales += len(fresh)
+                    with self._lock:
+                        self.upscales += len(fresh)
         return len(fresh) - len(to_stop)
 
     # -- background controller ------------------------------------------------
@@ -240,9 +254,11 @@ class ReplicaAutoscaler:
                 try:
                     self.tick()
                 except Exception:  # a failed spawn must not kill the loop
-                    self.errors += 1
+                    with self._lock:
+                        self.errors += 1
+                        n_errors = self.errors
                     log.exception("autoscaler tick failed for group %r "
-                                  "(failure #%d)", self.group, self.errors)
+                                  "(failure #%d)", self.group, n_errors)
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
         return self

@@ -180,7 +180,14 @@ class CacheService:
                 self.wfile.write(data)
 
             def do_GET(self):
-                self._reply(*service.handle("GET", self.path, None))
+                try:
+                    status, payload = service.handle("GET", self.path, None)
+                except Exception as e:  # noqa: BLE001 — a handler fault
+                    # (scrape callback, cache state) answers 500, never
+                    # drops the scraper's connection
+                    status, payload = 500, {
+                        "error": f"{type(e).__name__}: {e}"}
+                self._reply(status, payload)
 
             def do_POST(self):
                 try:
@@ -188,7 +195,20 @@ class CacheService:
                     body = json.loads(self.rfile.read(n)) if n else None
                 except (ValueError, json.JSONDecodeError):
                     return self._reply(422, {"error": "invalid JSON"})
-                self._reply(*service.handle("POST", self.path, body))
+                except Exception as e:  # noqa: BLE001 — truncated body /
+                    # transport fault mid-read: answer, don't unwind
+                    return self._reply(400, {
+                        "error": f"{type(e).__name__}: {e}"})
+                try:
+                    status, payload = service.handle("POST", self.path,
+                                                     body)
+                except Exception as e:  # noqa: BLE001 — e.g. a remote
+                    # embed_fn fault path nobody anticipated: the cache
+                    # is an optimization, its faults must be 500s the
+                    # gateway's fail-open client can count and skip
+                    status, payload = 500, {
+                        "error": f"{type(e).__name__}: {e}"}
+                self._reply(status, payload)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         bound = self._httpd.server_address

@@ -82,7 +82,7 @@ class LocalHandoff:
     def __init__(self, *, ttl_s: float = 120.0, clock=None):
         self.ttl_s = ttl_s
         self._clock = clock or time.monotonic
-        self._entries: dict[str, tuple[float, HostEntry]] = {}
+        self._entries: dict[str, tuple[float, HostEntry]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.published = 0
         self.claimed = 0
@@ -131,10 +131,14 @@ class RemoteHandoff:
             tuple(address), timeout=timeout,
             namespace=HANDOFF_NS_PREFIX + namespace)
         self._log = get_logger("serve.disagg")
-        self.published = 0
-        self.publish_errors = 0
-        self.claimed = 0
-        self.claim_errors = 0
+        # publishes run on the engine's publisher POOL and claims on
+        # concurrent HTTP handler threads — bare `+= 1` across those
+        # loses counts (read-modify-write is not GIL-atomic)
+        self._lock = threading.Lock()
+        self.published = 0        # guarded-by: _lock
+        self.publish_errors = 0   # guarded-by: _lock
+        self.claimed = 0          # guarded-by: _lock
+        self.claim_errors = 0     # guarded-by: _lock
 
     @property
     def address(self):
@@ -147,9 +151,11 @@ class RemoteHandoff:
         try:
             self._client.handoff_put(handoff_id, host)
         except (OSError, HandoffRejected):
-            self.publish_errors += 1
+            with self._lock:
+                self.publish_errors += 1
             raise
-        self.published += 1
+        with self._lock:
+            self.published += 1
 
     def claim(self, handoff_id: str) -> HostEntry | None:
         """``None`` = lost (expired / never published / already claimed /
@@ -162,13 +168,15 @@ class RemoteHandoff:
         try:
             host = self._client.handoff_claim(handoff_id)
         except (OSError, ValueError, KeyError, struct.error) as e:
-            self.claim_errors += 1
+            with self._lock:
+                self.claim_errors += 1
             self._log.warning("handoff claim %s failed (%s: %s) — "
                               "degrading to local prefill",
                               handoff_id, type(e).__name__, e)
             return None
         if host is not None:
-            self.claimed += 1
+            with self._lock:
+                self.claimed += 1
         return host
 
 

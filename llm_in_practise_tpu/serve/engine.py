@@ -164,13 +164,13 @@ class EngineStats:
 
     def __init__(self):
         self.lock = threading.Lock()
-        self.requests_total = 0
-        self.tokens_generated_total = 0
+        self.requests_total = 0         # guarded-by: lock
+        self.tokens_generated_total = 0  # guarded-by: lock
         self.ttft = HistogramAccumulator()
         self.tpot = HistogramAccumulator()
-        self.queue_depth = 0
-        self.active_slots = 0
-        self.requests_shed = 0
+        self.queue_depth = 0            # guarded-by: lock
+        self.active_slots = 0           # guarded-by: lock
+        self.requests_shed = 0          # guarded-by: lock
         # SLO goodput (obs/meter.py): inactive until thresholds are
         # configured (engine ttft_slo_s/tpot_slo_s kwargs, or the serve
         # benches post-warmup) — then every finished request's tokens

@@ -64,17 +64,19 @@ class Upstream:
     # graceful-degradation capacity when a role pool is empty.
     role: str = "both"
 
-    fails: int = 0
+    fails: int = 0             # guarded-by: lock
     cooldown_until: float = 0.0
-    pending: int = 0
-    served: int = 0
+    pending: int = 0           # guarded-by: lock
+    served: int = 0            # guarded-by: lock
     # per-upstream routing counters, exported at /metrics: picks says
     # where the router actually sends traffic (vs. served, which also
     # counts retries), cooldowns says how often this replica tripped the
-    # breaker, affinity_hits says how much of its traffic was cache-warm
-    picks: int = 0
-    cooldowns: int = 0
-    affinity_hits: int = 0
+    # breaker, affinity_hits says how much of its traffic was cache-warm.
+    # Incremented from concurrent handler threads → under the lock
+    # (bare += across threads loses counts); scrapes read lock-free.
+    picks: int = 0             # guarded-by: lock
+    cooldowns: int = 0         # guarded-by: lock
+    affinity_hits: int = 0     # guarded-by: lock
     lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def available(self, now: float) -> bool:
@@ -150,7 +152,8 @@ class Router:
             (u.pending + 1) / max(u.weight, 1e-9),
             u.served / max(u.weight, 1e-9),
         ))
-        chosen.picks += 1
+        with chosen.lock:
+            chosen.picks += 1
         return chosen
 
     def pick(self, group: str, exclude: set[int] = frozenset()) -> Upstream:
@@ -224,9 +227,10 @@ class PrefixAffinityRouter(Router):
             return (load + miss, u.served / max(u.weight, 1e-9))
 
         chosen = min(cands, key=score)
-        chosen.picks += 1
-        if id(chosen) == sticky_id:
-            chosen.affinity_hits += 1
+        with chosen.lock:
+            chosen.picks += 1
+            if id(chosen) == sticky_id:
+                chosen.affinity_hits += 1
         if key is not None:
             with self._lock:
                 self._affinity[key] = (now, id(chosen))
@@ -485,11 +489,18 @@ class Gateway:
         self.moderation = moderation
         self.timeout_s = timeout_s
         self.health_check_interval_s = health_check_interval_s
-        self.requests_total = 0
-        self.failures_total = 0
-        self.fallbacks_total = 0
-        self.handoff_total = 0         # prefill phases that published KV
-        self.handoff_failed_total = 0  # prefill phases that errored (degraded)
+        # request-plane counters are bumped from CONCURRENT handler
+        # threads — a bare `+= 1` there interleaves and loses counts
+        # (the unguarded-counter class graftlint's guarded-by pass
+        # flags); scrape callbacks read through _counter_snapshot so
+        # the seeded attrs are read under their lock via one helper
+        self._stats_lock = threading.Lock()
+        self.requests_total = 0        # guarded-by: _stats_lock
+        self.failures_total = 0        # guarded-by: _stats_lock
+        self.fallbacks_total = 0       # guarded-by: _stats_lock
+        # prefill phases that published KV / errored (degraded)
+        self.handoff_total = 0         # guarded-by: _stats_lock
+        self.handoff_failed_total = 0  # guarded-by: _stats_lock
         self._disagg_model_warned: set = set()
         self._httpd: ThreadingHTTPServer | None = None
         self._health_thread: threading.Thread | None = None
@@ -633,7 +644,8 @@ class Gateway:
                     "handoff namespaces would never match; fix the "
                     "--upstream model names",
                     group, upstream.model, sorted(dec_models))
-            self.handoff_failed_total += 1
+            with self._stats_lock:
+                self.handoff_failed_total += 1
             return body
         ctx = span.context()
         headers = {"Content-Type": "application/json"}
@@ -662,18 +674,21 @@ class Gateway:
             # model it serves
             if e.code != 501:
                 upstream.record_failure(time.time())
-            self.handoff_failed_total += 1
+            with self._stats_lock:
+                self.handoff_failed_total += 1
             return body
         except (urllib.error.URLError, TimeoutError, OSError,
                 ValueError, KeyError):
             upstream.record_failure(time.time())
-            self.handoff_failed_total += 1
+            with self._stats_lock:
+                self.handoff_failed_total += 1
             return body
         finally:
             with upstream.lock:
                 upstream.pending -= 1
         upstream.record_success()
-        self.handoff_total += 1
+        with self._stats_lock:
+            self.handoff_total += 1
         span.set(handoff_id=hid, ok=True)
         # the model rides along: the handoff namespace IS the model
         # name, so the decode pick must prefer replicas serving it —
@@ -735,7 +750,8 @@ class Gateway:
 
     def _route(self, body: dict, stream: bool,
                span) -> tuple[int, object]:
-        self.requests_total += 1
+        with self._stats_lock:
+            self.requests_total += 1
         group = body.get("model") or (self.router.groups() or ["default"])[0]
 
         if self.moderation is not None:
@@ -768,7 +784,8 @@ class Gateway:
             cw = [g for g in self.context_window_fallbacks.get(group, [])]
             if cw:
                 chain = cw + [g for g in chain if g not in cw]
-                self.fallbacks_total += 1
+                with self._stats_lock:
+                    self.fallbacks_total += 1
 
         # disaggregated dispatch (DisaggRouter only): prefill the prompt
         # at the prefill pool first; the forwarded body then carries the
@@ -780,7 +797,8 @@ class Gateway:
         last_status, last_detail = 502, {"error": {"message": "no upstream"}}
         for gi, g in enumerate(chain):
             if gi > 0:
-                self.fallbacks_total += 1
+                with self._stats_lock:
+                    self.fallbacks_total += 1
             g_body = handoff_body if g == group else body
             tried: set[int] = set()
             retriable = True
@@ -807,7 +825,8 @@ class Gateway:
                     retriable = status in (0, 429) or status >= 500
                     if retriable:
                         upstream.record_failure(time.time())
-                        self.failures_total += 1
+                        with self._stats_lock:
+                            self.failures_total += 1
                     last_status, last_detail = (status or 502), resp
                     max_r = self.retry_policy.retries_for(
                         None if status == 0 else status)
@@ -819,6 +838,21 @@ class Gateway:
                     # a 4xx from one upstream will 4xx everywhere; stop
                     return last_status, last_detail
         return last_status, last_detail
+
+    def _counter_snapshot(self) -> dict:
+        """Request-plane counters read under their lock — the one
+        helper the scrape callbacks go through (each family is a single
+        int; Prometheus never promises cross-family atomicity, so each
+        callback snapshotting independently is fine — the lock is held
+        per collect, a few uncontended acquisitions per scrape)."""
+        with self._stats_lock:
+            return {
+                "requests": self.requests_total,
+                "failures": self.failures_total,
+                "fallbacks": self.fallbacks_total,
+                "handoff": self.handoff_total,
+                "handoff_failed": self.handoff_failed_total,
+            }
 
     # --- health checks -------------------------------------------------------
 
@@ -852,13 +886,13 @@ class Gateway:
         dashboards keep matching."""
         reg = Registry()
         reg.counter_func("gateway_requests_total",
-                         lambda: self.requests_total,
+                         lambda: self._counter_snapshot()["requests"],
                          "completions routed")
         reg.counter_func("gateway_upstream_failures_total",
-                         lambda: self.failures_total,
+                         lambda: self._counter_snapshot()["failures"],
                          "retriable upstream failures observed")
         reg.counter_func("gateway_fallbacks_total",
-                         lambda: self.fallbacks_total,
+                         lambda: self._counter_snapshot()["fallbacks"],
                          "fallback-chain hops taken")
         if self.cache is not None:
             cache = self.cache
@@ -876,10 +910,10 @@ class Gateway:
                 reg.counter_func("gateway_cache_skipped_total",
                                  lambda: cache.skipped)
         reg.counter_func("gateway_handoff_total",
-                         lambda: self.handoff_total,
+                         lambda: self._counter_snapshot()["handoff"],
                          "prefill phases that published KV")
         reg.counter_func("gateway_handoff_failed_total",
-                         lambda: self.handoff_failed_total,
+                         lambda: self._counter_snapshot()["handoff_failed"],
                          "prefill phases that errored (degraded)")
         reg.counter_func(
             "gateway_disagg_degraded_total",
@@ -924,12 +958,18 @@ class Gateway:
             def do_GET(self):
                 if serve_obs_get(self, gw.metrics_text, gw.tracer):
                     return
-                if self.path == "/v1/models":
-                    return self._json(200, {
-                        "object": "list",
-                        "data": [{"id": g, "object": "model"}
-                                 for g in gw.router.groups()],
-                    })
+                try:
+                    if self.path == "/v1/models":
+                        return self._json(200, {
+                            "object": "list",
+                            "data": [{"id": g, "object": "model"}
+                                     for g in gw.router.groups()],
+                        })
+                except Exception as e:  # noqa: BLE001 — answer the
+                    # client; never drop the connection on a GET fault
+                    return self._json(500, {"error": {
+                        "message": f"{type(e).__name__}: {e}",
+                        "type": "internal_error"}})
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):

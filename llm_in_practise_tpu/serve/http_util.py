@@ -50,20 +50,42 @@ def serve_obs_get(handler: JsonHandler, metrics_text, tracer=None) -> bool:
     grouped by trace id). Returns True when the path was handled.
 
     ``metrics_text`` is a zero-arg callable; ``tracer`` defaults to the
-    process tracer (servers constructed with their own pass it in)."""
+    process tracer (servers constructed with their own pass it in).
+
+    Fail-contained by contract (graftlint's ``handler-fail-open``
+    safe-call list relies on it): a scrape callback that raises — a
+    registry ``*_func`` over an object in a bad state — answers a 500
+    JSON body instead of unwinding into socketserver, which would drop
+    the connection and log a traceback nobody scrapes."""
     if handler.path == "/health":
         handler._json(200, {"status": "ok"})
         return True
     if handler.path == "/metrics":
-        handler._text(200, metrics_text().encode(),
-                      "text/plain; version=0.0.4")
+        try:
+            body = metrics_text().encode()
+        except Exception as e:  # noqa: BLE001 — a broken scrape callback
+            # must answer the scraper, never kill the handler thread
+            handler._json(500, {"error": {
+                "message": f"metrics render failed: "
+                           f"{type(e).__name__}: {e}",
+                "type": "internal_error"}})
+            return True
+        handler._text(200, body, "text/plain; version=0.0.4")
         return True
     if handler.path == "/debug/traces":
-        if tracer is None:
-            from llm_in_practise_tpu.obs.trace import get_tracer
+        try:
+            if tracer is None:
+                from llm_in_practise_tpu.obs.trace import get_tracer
 
-            tracer = get_tracer()
-        handler._json(200, tracer.debug_payload())
+                tracer = get_tracer()
+            payload = tracer.debug_payload()
+        except Exception as e:  # noqa: BLE001 — same contract as /metrics
+            handler._json(500, {"error": {
+                "message": f"trace snapshot failed: "
+                           f"{type(e).__name__}: {e}",
+                "type": "internal_error"}})
+            return True
+        handler._json(200, payload)
         return True
     return False
 

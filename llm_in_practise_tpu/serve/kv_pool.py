@@ -278,18 +278,18 @@ class KVPoolServer:
         self.max_handoff_bytes = max_handoff_bytes
         self._clock = clock or time.monotonic
         # (ns, id) -> (expires_at, length, bucket, blob)
-        self._handoff: dict[tuple[str, str], tuple[float, int, int, bytes]] = {}
-        self._handoff_bytes = 0
+        self._handoff: dict[tuple[str, str], tuple[float, int, int, bytes]] = {}  # guarded-by: _acct_lock
+        self._handoff_bytes = 0  # guarded-by: _acct_lock
         self.handoff_puts = 0
         self.handoff_claims = 0
         self.handoff_expired = 0
         self.handoff_rejected = 0
-        self._namespaces: set[str] = set()
+        self._namespaces: set[str] = set()  # guarded-by: _acct_lock
         # live entries per namespace: a namespace whose last entry is
         # evicted releases its slot (rolling model redeploys would
         # otherwise exhaust max_namespaces forever)
-        self._ns_counts: dict[str, int] = {}
-        self._total_bytes = 0
+        self._ns_counts: dict[str, int] = {}  # guarded-by: _acct_lock
+        self._total_bytes = 0  # guarded-by: _acct_lock
         # RLock: _put holds it across peek/account/store.put so concurrent
         # puts of the same key cannot double-count, and the store's
         # on_evict (which re-enters for the byte decrement) fires on the
@@ -378,7 +378,7 @@ class KVPoolServer:
         reg.gauge_func("kvpool_cached_bytes", lambda: self.cached_bytes,
                        "bytes pinned by LRU entries (RAM in use)")
         reg.gauge_func("kvpool_namespaces",
-                       lambda: len(self._namespaces))
+                       lambda: self.n_namespaces)
         reg.counter_func(
             "kvpool_handoff_total",
             lambda: [({"event": "pinned"}, self.handoff_puts),
@@ -387,9 +387,9 @@ class KVPoolServer:
                      ({"event": "rejected"}, self.handoff_rejected)],
             "disaggregated handoff pins/claims/TTL-reclaims/refusals")
         reg.gauge_func("kvpool_handoff_pending",
-                       lambda: len(self._handoff))
+                       lambda: self.handoff_pending)
         reg.gauge_func("kvpool_handoff_bytes",
-                       lambda: self._handoff_bytes,
+                       lambda: self.handoff_bytes,
                        "bytes pinned by unclaimed handoff entries")
         return reg
 
@@ -526,6 +526,26 @@ class KVPoolServer:
     def cached_bytes(self) -> int:
         with self._acct_lock:
             return self._total_bytes
+
+    # scrape-plane reads of _acct_lock-guarded state go through these
+    # locked properties — a /metrics collect must never see a handoff
+    # byte total mid-update (the scrape-callback-vs-writer torn read
+    # graftlint's guarded-by pass flags)
+
+    @property
+    def handoff_bytes(self) -> int:
+        with self._acct_lock:
+            return self._handoff_bytes
+
+    @property
+    def handoff_pending(self) -> int:
+        with self._acct_lock:
+            return len(self._handoff)
+
+    @property
+    def n_namespaces(self) -> int:
+        with self._acct_lock:
+            return len(self._namespaces)
 
     @property
     def _entries(self):

@@ -152,7 +152,15 @@ class ModerationService:
                 body, err = self._read_json()
                 if err:
                     return self._json(400, err)
-                status, resp = svc.handle(body)
+                try:
+                    status, resp = svc.handle(body)
+                except Exception as e:  # noqa: BLE001 — a pluggable
+                    # classifier's fault must answer the caller (the
+                    # gateway fails open on moderation errors), never
+                    # drop the connection
+                    status, resp = 500, {"error": {
+                        "message": f"{type(e).__name__}: {e}",
+                        "type": "internal_error"}}
                 return self._json(status, resp)
 
         return Handler

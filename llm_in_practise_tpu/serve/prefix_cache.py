@@ -62,11 +62,11 @@ class PrefixLRU:
         self.min_prefix = min_prefix
         self.on_evict = on_evict
         self._length_of = length_of or (lambda v: v.length)
-        self._entries: "OrderedDict[tuple, object]" = OrderedDict()
         # internal lock: the owner's worker thread mutates while /metrics
         # (or another engine thread) reads
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._total_tokens = 0
+        self._total_tokens = 0  # guarded-by: _lock
         self.hits = 0
         self.misses = 0
 

@@ -54,12 +54,19 @@ class WebUI:
                 self.wfile.write(data)
 
             def do_GET(self):
-                if self.path in ("/", "/index.html"):
-                    page = webui_html(ui.model_name).encode()
-                    return self._send(200, page, "text/html; charset=utf-8")
-                if self.path == "/health":
-                    return self._send(200, b'{"status": "ok"}',
-                                      "application/json")
+                try:
+                    if self.path in ("/", "/index.html"):
+                        page = webui_html(ui.model_name).encode()
+                        return self._send(200, page,
+                                          "text/html; charset=utf-8")
+                    if self.path == "/health":
+                        return self._send(200, b'{"status": "ok"}',
+                                          "application/json")
+                except Exception as e:  # noqa: BLE001 — answer the
+                    # browser, never drop the connection on a GET fault
+                    return self._send(500, json.dumps({"error": {
+                        "message": f"{type(e).__name__}: {e}"}}).encode(),
+                        "application/json")
                 self._send(404, b'{"error": "not found"}',
                            "application/json")
 
@@ -67,18 +74,30 @@ class WebUI:
                 if self.path != "/v1/chat/completions":
                     return self._send(404, b'{"error": "not found"}',
                                       "application/json")
-                n = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(n) if n else b"{}"
-                req = urllib.request.Request(
-                    ui.gateway_url + "/v1/chat/completions", data=body,
-                    headers={"Content-Type": "application/json"},
-                )
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n) if n else b"{}"
+                    req = urllib.request.Request(
+                        ui.gateway_url + "/v1/chat/completions", data=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                except Exception as e:  # noqa: BLE001 — truncated body /
+                    # bad gateway URL: a 400 the browser can show
+                    return self._send(400, json.dumps({"error": {
+                        "message": f"{type(e).__name__}: {e}"}}).encode(),
+                        "application/json")
                 try:
                     resp = urllib.request.urlopen(req, timeout=ui.timeout_s)
                 except urllib.error.HTTPError as e:
-                    return self._send(e.code, e.read() or b"{}",
-                                      "application/json")
-                except (urllib.error.URLError, TimeoutError, OSError) as e:
+                    try:
+                        detail = e.read() or b"{}"
+                    except Exception:  # noqa: BLE001 — error body gone
+                        # (peer closed mid-read); the status code stands
+                        detail = b"{}"
+                    return self._send(e.code, detail, "application/json")
+                except Exception as e:  # noqa: BLE001 — unreachable,
+                    # timeout, bad scheme: a 502 the browser can show,
+                    # never a dropped connection
                     return self._send(502, json.dumps({"error": {
                         "message": f"gateway unreachable: {e}"}}).encode(),
                         "application/json")
@@ -101,8 +120,19 @@ class WebUI:
                                 self.wfile.flush()
                         except (BrokenPipeError, ConnectionResetError):
                             pass  # browser went away mid-stream
+                        except Exception:  # noqa: BLE001 — upstream died
+                            # mid-relay; headers are out, just stop
+                            pass
                         return
-                    self._send(resp.status, resp.read(), ctype)
+                    try:
+                        payload = resp.read()
+                    except Exception as e:  # noqa: BLE001 — gateway died
+                        # mid-body: a 502 the browser can show
+                        return self._send(502, json.dumps({"error": {
+                            "message": f"gateway read failed: "
+                                       f"{e}"}}).encode(),
+                            "application/json")
+                    self._send(resp.status, payload, ctype)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         bound = self._httpd.server_address

@@ -34,7 +34,6 @@ import optax
 
 from llm_in_practise_tpu.ckpt import checkpoint as ckpt_lib
 from llm_in_practise_tpu.core import config as config_lib
-from llm_in_practise_tpu.core import dist
 from llm_in_practise_tpu.core import mesh as mesh_lib
 from llm_in_practise_tpu.data.loader import batch_iterator
 from llm_in_practise_tpu.obs import Throughput, EpochTimer, RollingMean, get_logger

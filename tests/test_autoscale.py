@@ -134,3 +134,60 @@ def test_steady_state_resets_pending_decisions():
     scaler.tick(now=10.0)
     _load(router, [20, 20])
     assert scaler.tick(now=35.0) == 0        # delay restarted at re-arm
+
+
+def test_ongoing_is_thread_safe_against_tick():
+    """Regression (graftlint guarded-by): ``ongoing()`` used to read
+    ``_draining`` lock-free while ``tick()`` mutated it on the
+    controller thread. It now takes the state lock (tick holds it and
+    uses ``_ongoing_locked``), so concurrent calls neither deadlock nor
+    race the drain list."""
+    import threading
+
+    router, scaler, stopped = _make(
+        n_start=4, min_replicas=1, max_replicas=4,
+        downscale_delay_s=0.0, upscale_delay_s=0.0,
+        look_back_period_s=0.0)
+    _load(router, [0, 0, 0, 0])
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                scaler.ongoing()
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        now = 0.0
+        for _ in range(200):  # drains victims while readers hammer
+            scaler.tick(now)
+            now += 1.0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    assert not errors
+    assert scaler.ongoing() == 0
+
+
+def test_decision_counters_update_under_lock():
+    """Regression (graftlint guarded-by): upscales/downscales are now
+    booked under the scaler lock; the counts stay exact across a
+    scale-up/scale-down cycle driven while readers poll."""
+    router, scaler, stopped = _make(
+        n_start=1, min_replicas=1, max_replicas=3,
+        upscale_delay_s=0.0, downscale_delay_s=0.0,
+        look_back_period_s=0.0, target_ongoing_requests=1.0)
+    _load(router, [3])
+    assert scaler.tick(0.0) == 2          # scale 1 -> 3
+    assert scaler.upscales == 2
+    _load(router, [0, 0, 0])
+    scaler.tick(1.0)                       # victims drain
+    delta = scaler.tick(2.0)               # victims reaped
+    assert delta <= 0
+    assert scaler.downscales == len(stopped) == 2

@@ -16,6 +16,14 @@ import sys
 
 import pytest
 
+from tests import envcaps
+
+# the CPU backend hard-refuses cross-process computations; the test
+# re-arms on any backend whose collectives span processes
+pytestmark = pytest.mark.skipif(
+    not envcaps.multiprocess_collectives_supported(),
+    reason=envcaps.multiprocess_reason())
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER = r"""

@@ -1,0 +1,464 @@
+"""graftlint (tools/graftlint): rule fixtures + the tier-1 repo gate.
+
+Every rule is pinned four ways: a firing fixture, an allowlisted site,
+an inline suppression, and a baseline entry — the three suppression
+mechanisms must each actually suppress, and only the intended rule.
+``test_repo_scan_matches_baseline`` is the tier-1 wiring: the committed
+``tools/graftlint/baseline.toml`` must exactly match a fresh scan (no
+new findings, no stale entries) — the same check
+``python -m tools.graftlint`` enforces at the CLI.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.graftlint.core import (  # noqa: E402
+    Config,
+    Finding,
+    SourceFile,
+    diff_against_baseline,
+    render_baseline,
+)
+from tools.graftlint.runner import run_lint, run_passes  # noqa: E402
+
+
+def lint(code: str, rules=None, *, allow=None, safe_calls=None,
+         rel: str = "fixture_mod.py"):
+    sf = SourceFile(path=rel, rel=rel, text=textwrap.dedent(code))
+    config = Config(allow={k: set(v) for k, v in (allow or {}).items()},
+                    accepted={}, safe_calls=set(safe_calls or ()))
+    return run_passes([sf], config, set(rules) if rules else None)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- dispatch hygiene -------------------------------------------------------
+
+_ENGINE_SYNC = """
+    import numpy as np
+
+    class InferenceEngine:
+        def step(self):
+            self._helper()
+
+        def _helper(self):
+            return np.asarray(self.buf){suffix}
+"""
+
+
+def test_host_sync_fires_on_engine_path():
+    findings = lint(_ENGINE_SYNC.format(suffix=""), ["host-sync"])
+    assert [f.symbol for f in findings] == ["InferenceEngine._helper"]
+    assert findings[0].rule == "host-sync"
+
+
+def test_host_sync_ignores_unreachable_functions():
+    code = """
+    import numpy as np
+
+    def unrelated(buf):
+        return np.asarray(buf)
+    """
+    assert lint(code, ["host-sync"]) == []
+
+
+def test_host_sync_inline_suppression():
+    code = _ENGINE_SYNC.format(suffix="  # graftlint: disable=host-sync")
+    assert lint(code, ["host-sync"]) == []
+
+
+def test_host_sync_allowlisted_site():
+    findings = lint(
+        _ENGINE_SYNC.format(suffix=""), ["host-sync"],
+        allow={"host-sync": {"fixture_mod.py::InferenceEngine._helper"}})
+    assert findings == []
+
+
+def test_host_sync_baseline_entry():
+    findings = lint(_ENGINE_SYNC.format(suffix=""), ["host-sync"])
+    config = Config(allow={}, accepted={
+        ("fixture_mod.py", "host-sync", "InferenceEngine._helper"): 1,
+    }, safe_calls=set())
+    fresh, stale = diff_against_baseline(config, findings)
+    assert fresh == [] and stale == []
+    # the baseline is exact: fixing the finding makes the entry stale
+    fresh, stale = diff_against_baseline(config, [])
+    assert fresh == [] and stale == [
+        ("fixture_mod.py", "host-sync", "InferenceEngine._helper")]
+
+
+def test_tracer_bool_flags_traced_param_only():
+    code = """
+    import jax
+
+    def _decode_fn(params, x, *, n):
+        if x:{mark}
+            return params
+        if n:
+            return x
+        return x
+
+    _decode = jax.jit(_decode_fn, static_argnames=("n",))
+    """
+    findings = lint(code.format(mark=""), ["tracer-bool"])
+    assert len(findings) == 1 and "x" in findings[0].msg
+    assert lint(code.format(mark="  # graftlint: disable=tracer-bool"),
+                ["tracer-bool"]) == []
+
+
+# --- recompile hazards ------------------------------------------------------
+
+def test_jit_in_loop():
+    code = """
+    import jax
+
+    def compile_all(fns):
+        out = []
+        for fn in fns:
+            out.append(jax.jit(fn)){mark}
+        return out
+    """
+    assert rules_of(lint(code.format(mark=""), ["jit-in-loop"])) == [
+        "jit-in-loop"]
+    assert lint(code.format(mark="  # graftlint: disable=jit-in-loop"),
+                ["jit-in-loop"]) == []
+
+
+def test_jit_in_handler():
+    code = """
+    import jax
+
+    class Server:
+        def handle_chat(self, body):
+            fn = jax.jit(lambda x: x){mark}
+            return fn(body)
+    """
+    assert rules_of(lint(code.format(mark=""), ["jit-in-handler"])) == [
+        "jit-in-handler"]
+    assert lint(code.format(mark="  # graftlint: disable=jit-in-handler"),
+                ["jit-in-handler"]) == []
+
+
+def test_jit_scalar_arg():
+    code = """
+    import jax
+
+    class Engine:
+        def __init__(self):
+            self._fn = jax.jit(self._impl, static_argnames=("n",))
+
+        def _impl(self, a, *, n):
+            return a
+
+        def go(self, a):
+            return self._fn(3, n=2){mark}
+    """
+    findings = lint(code.format(mark=""), ["jit-scalar-arg"])
+    # the positional literal fires; n=2 is static and does not
+    assert len(findings) == 1 and "position 0" in findings[0].msg
+    assert lint(code.format(mark="  # graftlint: disable=jit-scalar-arg"),
+                ["jit-scalar-arg"]) == []
+
+
+def test_jit_static_positional_drift():
+    drift = """
+    import jax
+
+    class Engine:
+        def __init__(self):
+            self._fn = jax.jit(self._impl, static_argnames=("bucket",))
+
+        def _impl(self, a, bucket):
+            return a
+
+        def one(self, a, b):
+            return self._fn(a, b){mark}
+
+        def two(self, a, b):
+            return self._fn(a, bucket=4)
+    """
+    findings = lint(drift.format(mark=""), ["jit-static-positional"])
+    assert [f.symbol for f in findings] == ["Engine.one"]
+    assert lint(drift.format(
+        mark="  # graftlint: disable=jit-static-positional"),
+        ["jit-static-positional"]) == []
+    # consistent style (both positional) is NOT drift
+    consistent = drift.format(mark="").replace("bucket=4", "4")
+    assert lint(consistent, ["jit-static-positional"]) == []
+
+
+# --- lock discipline --------------------------------------------------------
+
+_GUARDED = """
+    import threading
+
+    class Meter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0  # guarded-by: _lock
+
+        def good(self):
+            with self._lock:
+                self.count += 1
+
+        def bad(self):
+            self.count += 1{mark}
+
+        def _sweep_locked(self):
+            self.count = 0
+"""
+
+
+def test_guarded_by_flags_unlocked_access_only():
+    findings = lint(_GUARDED.format(mark=""), ["guarded-by"])
+    assert [f.symbol for f in findings] == ["Meter.bad"]
+    assert "write" in findings[0].msg
+
+
+def test_guarded_by_exempts_init_and_locked_suffix():
+    # __init__ and *_locked never fire — only Meter.bad does, and an
+    # inline disable silences it
+    code = _GUARDED.format(mark="  # graftlint: disable=guarded-by")
+    assert lint(code, ["guarded-by"]) == []
+
+
+def test_guarded_by_allowlist():
+    assert lint(_GUARDED.format(mark=""), ["guarded-by"],
+                allow={"guarded-by": {"fixture_mod.py::Meter.bad"}}) == []
+
+
+def test_lock_rules_respect_nested_class_boundaries():
+    """Regression: ``ast.walk(cls)`` descends into nested classes (the
+    stack's ``class Handler`` inside ``make_handler``) — their ``self``
+    is a different object, so the outer class's guarded map must not
+    apply, and a nested-class blocking call must be reported exactly
+    once (under the nested class), not twice."""
+    code = """
+    import threading
+    import time
+
+    class Outer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0  # guarded-by: _lock
+
+        def make_handler(self):
+            class Handler:
+                def do_GET(self):
+                    self.count = 1      # Handler's own attr, not Outer's
+                    with self._lock:
+                        time.sleep(0.1)
+            return Handler
+    """
+    assert lint(code, ["guarded-by"]) == []
+    blocking = lint(code, ["lock-blocking"])
+    assert [f.symbol for f in blocking] == ["Handler.do_GET"]
+
+
+def test_lock_blocking():
+    code = """
+    import threading
+    import time
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                time.sleep(0.1){mark}
+
+        def good(self):
+            time.sleep(0.1)
+    """
+    findings = lint(code.format(mark=""), ["lock-blocking"])
+    assert [f.symbol for f in findings] == ["Pool.bad"]
+    assert lint(code.format(mark="  # graftlint: disable=lock-blocking"),
+                ["lock-blocking"]) == []
+
+
+# --- fail-open handlers -----------------------------------------------------
+
+_HANDLER = """
+    class Handler:
+        def do_POST(self):
+            body, err = self._read_json()
+            {body}
+"""
+
+
+def test_handler_fail_open():
+    fired = lint(_HANDLER.format(body="self.dispatch(body)"),
+                 ["handler-fail-open"])
+    assert rules_of(fired) == ["handler-fail-open"]
+    covered = """
+    class Handler:
+        def do_POST(self):
+            body, err = self._read_json()
+            try:
+                self.dispatch(body)
+            except Exception:
+                self._json(500, {})
+    """
+    assert lint(covered, ["handler-fail-open"]) == []
+    # [handlers] safe_calls config entries are trusted fail-contained
+    assert lint(_HANDLER.format(body="self.dispatch(body)"),
+                ["handler-fail-open"], safe_calls={"dispatch"}) == []
+
+
+# --- unused imports ---------------------------------------------------------
+
+def test_unused_import():
+    code = """
+    import os
+    import sys
+
+    print(sys.path)
+    """
+    findings = lint(code, ["unused-import"])
+    assert len(findings) == 1 and "'os'" in findings[0].msg
+
+
+def test_unused_import_exemptions():
+    code = """
+    import os  # noqa: F401
+    from typing import Any
+
+    try:
+        import probe_mod
+    except ImportError:
+        probe_available = False
+
+    class C:
+        field: "list[Any]" = None
+    """
+    # noqa honored, probe-import idiom honored, string annotation counts
+    assert lint(code, ["unused-import"]) == []
+
+
+# --- baseline machinery -----------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    config = Config(allow={"host-sync": {"a.py::f"}}, accepted={},
+                    safe_calls={"dispatch"})
+    findings = [Finding("b.py", 3, "guarded-by", "C.m", "msg"),
+                Finding("b.py", 9, "guarded-by", "C.m", "msg2")]
+    text = render_baseline(config, findings)
+    path = tmp_path / "baseline.toml"
+    path.write_text(text)
+    loaded = Config.load(str(path))
+    assert loaded.allow == {"host-sync": {"a.py::f"}}
+    assert loaded.safe_calls == {"dispatch"}
+    assert loaded.accepted == {("b.py", "guarded-by", "C.m"): 2}
+
+
+def test_write_baseline_preserves_hand_written_prelude(tmp_path):
+    """``--write-baseline`` regenerates only the [[accepted]] tables —
+    the hand-maintained [handlers]/[allow] head (rationale comments
+    included, even ones that mention "[[accepted]]" in prose) survives
+    verbatim, and regeneration is idempotent."""
+    import shutil
+
+    from tools.graftlint import runner
+
+    copy = tmp_path / "baseline.toml"
+    shutil.copy(runner.BASELINE_PATH, copy)
+    before = copy.read_text()
+    runner.write_baseline(baseline_path=str(copy))
+    after = copy.read_text()
+    assert "host-sync force-points" in after  # the rationale comments
+    assert before.rstrip() == after.rstrip()
+    runner.write_baseline(baseline_path=str(copy))
+    assert copy.read_text().rstrip() == after.rstrip()
+
+
+# --- the tier-1 gate --------------------------------------------------------
+
+def test_repo_scan_matches_baseline():
+    """The committed baseline must exactly match a fresh scan of the
+    repo — zero new findings AND zero stale entries. This test IS the
+    tier-1 wiring for ``python -m tools.graftlint`` (same code path,
+    same config)."""
+    fresh, stale, live, _config = run_lint()
+    assert fresh == [], "new graftlint findings:\n" + "\n".join(
+        f.render() for f in fresh)
+    assert stale == [], (
+        "baselined graftlint findings no longer fire (regenerate with "
+        f"python -m tools.graftlint --write-baseline): {stale}")
+
+
+def test_cli_contract():
+    """Shared CLI contract (tools/graftlint/report.py): rc 0 on a clean
+    scan, rc 2 on usage errors — the same exit codes
+    tools/check_metric_docs.py uses. A scoped --write-baseline is
+    refused (a partial scan would silently drop [[accepted]] entries
+    outside the given roots)."""
+    from tools.graftlint.__main__ import main
+
+    assert main([]) == 0
+    assert main(["--rule", "no-such-rule"]) == 2
+    assert main(["llm_in_practise_tpu/serve", "--write-baseline"]) == 2
+
+
+def test_rule_and_root_scoped_runs_ignore_foreign_baseline_entries():
+    """A --rule/path-restricted run must not report baselined findings
+    of OTHER rules/paths as stale (they still fire under a full scan —
+    the restriction just didn't look)."""
+    findings = lint(_ENGINE_SYNC.format(suffix=""), ["host-sync"])
+    config = Config(allow={}, accepted={
+        # same file, different rule — invisible to a host-sync-only run
+        ("fixture_mod.py", "unused-import", "<module>"): 1,
+        # different file entirely — invisible to this scan
+        ("other_mod.py", "host-sync", "f"): 1,
+        ("fixture_mod.py", "host-sync", "InferenceEngine._helper"): 1,
+    }, safe_calls=set())
+    # mimic run_lint's restriction: only keys the scoped scan could
+    # have produced participate in the stale check
+    scanned = {"fixture_mod.py"}
+    config.accepted = {k: n for k, n in config.accepted.items()
+                      if k[1] in {"host-sync"} and k[0] in scanned}
+    fresh, stale = diff_against_baseline(config, findings)
+    assert fresh == [] and stale == []
+
+
+def test_run_lint_rule_filter_does_not_fail_on_other_rules(tmp_path):
+    """End-to-end: a baseline with an accepted finding of rule A must
+    not make a --rule B run fail as stale."""
+    target = tmp_path / "mod.py"
+    target.write_text("import os\n")  # one unused-import finding
+    baseline = tmp_path / "baseline.toml"
+    baseline.write_text(
+        '[[accepted]]\nfile = "mod.py"\nrule = "unused-import"\n'
+        'symbol = "<module>"\ncount = 1\n')
+    fresh, stale, live, _ = run_lint(
+        roots=("mod.py",), repo=str(tmp_path),
+        baseline_path=str(baseline), rules={"host-sync"})
+    assert fresh == [] and stale == [] and live == []
+    # the full run still honors the entry
+    fresh, stale, live, _ = run_lint(
+        roots=("mod.py",), repo=str(tmp_path),
+        baseline_path=str(baseline))
+    assert fresh == [] and stale == [] and len(live) == 1
+
+
+def test_fixture_findings_render_with_shared_prefix(capsys):
+    from tools.graftlint import report
+
+    rc = report.emit("graftlint", ["a.py:1: [r] s: m"],
+                     ok_summary="clean", fail_hint="fix it")
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert out.startswith("graftlint: a.py:1: [r] s: m")
+    assert "FAIL — 1 problem(s). fix it" in out
+    rc = report.emit("graftlint", [], ok_summary="clean")
+    assert rc == 0
+    assert "OK — clean" in capsys.readouterr().out

@@ -14,6 +14,13 @@ import pytest
 
 from llm_in_practise_tpu.core import mesh as mesh_lib
 from llm_in_practise_tpu.ops.ring_attention import make_ring_attention
+from tests import envcaps
+
+# both tests run ring attention under shard_map(check_vma=...) — an
+# env capability probe, not a known-failure waiver (tests/envcaps.py)
+pytestmark = pytest.mark.skipif(
+    not envcaps.shard_map_has_check_vma(),
+    reason=envcaps.SHARD_MAP_CHECK_VMA_REASON)
 
 
 def test_16k_ring_attention_runs(devices, rng):

@@ -284,3 +284,96 @@ def test_kv_pool_metrics_server_strict():
         assert "summary" in traces and "traces" in traces
     finally:
         server.stop()
+
+
+# --- thread-safety regressions (graftlint lock-discipline pass) -------------
+
+
+def test_handoff_meter_counts_exact_under_contention():
+    """Regression: HandoffMeter's ``+= 1`` ran bare on concurrent HTTP
+    handler threads — interleaved read-modify-writes lost counts. The
+    increments now hold the meter's lock; N threads x M bumps must sum
+    exactly."""
+    import threading
+
+    from llm_in_practise_tpu.obs.meter import HandoffMeter
+
+    meter = HandoffMeter()
+    N, M = 8, 500
+
+    def work(i):
+        for j in range(M):
+            meter.claim_outcome(entry_found=(j % 2 == 0))
+            meter.note_repin(ok=(j % 3 == 0))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert meter.claimed + meter.lost == N * M
+    assert meter.claimed == N * M // 2
+    assert meter.repinned + meter.repin_failed == N * M
+
+
+def test_goodput_families_render_one_consistent_snapshot():
+    """Regression: the goodput scrape callbacks read tokens_ok and
+    tokens_violated as two separate unlocked attribute reads — a scrape
+    racing observe() could render an ok count from before the update
+    and a violated count from after it. register_goodput now reads both
+    halves of a family from ONE locked snapshot: under a concurrent
+    writer, every render's ok+violated total is a value the meter
+    actually passed through (monotone, never torn)."""
+    import threading
+
+    from llm_in_practise_tpu.obs.meter import GoodputMeter, register_goodput
+
+    meter = GoodputMeter(ttft_slo_s=0.5, tpot_slo_s=0.5)
+    reg = Registry()
+    register_goodput(reg, meter)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            # alternate ok / violated, one token each
+            meter.observe(tokens=1, ttft_s=0.1 if i % 2 else 0.9)
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        prev = -1
+        for _ in range(300):
+            parsed = parse_exposition(reg.render())
+            sample = {dict(labelset).get("slo"): value
+                      for (_, labelset), value
+                      in parsed["llm_slo_requests_total"].samples.items()}
+            total = int(sample["ok"] + sample["violated"])
+            assert total >= prev, "ok+violated went backwards (torn read)"
+            prev = total
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_kvpool_scrape_properties_hold_the_accounting_lock():
+    """Regression: the kv-pool's handoff gauges read _acct_lock-guarded
+    state from scrape lambdas without the lock. They now go through
+    locked properties; values must match the authoritative stats op."""
+    from llm_in_practise_tpu.serve.kv_pool import KVPoolServer, encode_entry
+    from llm_in_practise_tpu.serve.kv_pool import HostEntry
+    import numpy as np
+
+    pool = KVPoolServer(port=0)
+    host = HostEntry(length=16, bucket=16,
+                     rows=[{"k": np.zeros((1, 16, 2, 4), np.float32)}],
+                     last_logits=np.zeros((1, 8), np.float32))
+    ok, why = pool._handoff_put("m", "h1", 16, 16, encode_entry(host))
+    assert ok, why
+    assert pool.handoff_pending == 1
+    assert pool.handoff_bytes > 0
+    assert pool.n_namespaces == 0  # handoff namespace is separate
+    got = pool._handoff_claim("m", "h1")
+    assert got is not None
+    assert pool.handoff_pending == 0 and pool.handoff_bytes == 0

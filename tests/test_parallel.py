@@ -17,6 +17,7 @@ from llm_in_practise_tpu.core import mesh as mesh_lib
 from llm_in_practise_tpu.models.gpt import GPT, minigpt_v1_config
 from llm_in_practise_tpu.parallel import strategy as S
 from llm_in_practise_tpu.train.step import make_train_step
+from tests import envcaps
 
 
 VOCAB = 64
@@ -94,6 +95,8 @@ def test_zero1_shards_opt_state_only(devices):
     assert mu.sharding.spec == P("fsdp", "model")
 
 
+@pytest.mark.skipif(not envcaps.shard_map_has_check_vma(),
+                    reason=envcaps.OLD_XLA_CPU_NUMERICS_REASON)
 def test_sharded_matches_single_device(devices):
     """The load-bearing guarantee: every strategy computes the SAME training
     trajectory as one device — sharding is placement, not math."""

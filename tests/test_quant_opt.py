@@ -5,8 +5,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from llm_in_practise_tpu.train import quant_opt
+from tests import envcaps
 
 
 def test_q8_codec_roundtrip_error():
@@ -78,6 +80,8 @@ def test_trainstate_with_8bit_opt_checkpoints(tmp_path):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
+@pytest.mark.skipif(not envcaps.has_pinned_host_memory(),
+                    reason=envcaps.pinned_host_reason())
 def test_zero_offload_places_opt_state_on_host(devices):
     from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
     from llm_in_practise_tpu.parallel import strategy as S

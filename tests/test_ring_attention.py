@@ -13,6 +13,14 @@ import pytest
 from llm_in_practise_tpu.core import mesh as mesh_lib
 from llm_in_practise_tpu.ops.attention import dense_attention
 from llm_in_practise_tpu.ops.ring_attention import make_ring_attention
+from tests import envcaps
+
+# env capability, not a code bug: every test here goes through the
+# shard_map(check_vma=...) wrap — re-arms automatically on a jax that
+# has it (tests/envcaps.py)
+pytestmark = pytest.mark.skipif(
+    not envcaps.shard_map_has_check_vma(),
+    reason=envcaps.SHARD_MAP_CHECK_VMA_REASON)
 
 
 def _qkv(rng, batch=2, seq=64, heads=4, head_dim=16, kv_heads=None):

@@ -15,7 +15,11 @@ spans, ``{a,b,c}`` brace alternation
 (``llm_handoff_total{event=…}``), and ``*`` globs
 (``llm_prefix_cache_*``).
 
-Run standalone: ``python tools/check_metric_docs.py`` (rc 1 on drift).
+Run standalone: ``python tools/check_metric_docs.py``. Report lines and
+exit codes follow the repo's shared checker contract
+(``tools/graftlint/report.py``): rc 0 clean, rc 1 on drift, rc 2 on an
+internal error — same shape ``python -m tools.graftlint`` emits, so
+tier-1 logs and CI greps read identically across checkers.
 """
 
 from __future__ import annotations
@@ -156,18 +160,24 @@ def check(registered=None, md_text: str | None = None) -> list[str]:
 
 
 def main() -> int:
-    missing = check()
-    if missing:
-        print("metric families registered in code but MISSING from "
-              f"{os.path.relpath(DOC, REPO)}:")
-        for name in missing:
-            print(f"  - {name}")
-        print("add a catalog row (docs/observability.md) for each, or "
-              "fix the drifted name.")
-        return 1
-    print(f"OK: every registered metric family is documented in "
-          f"{os.path.relpath(DOC, REPO)}.")
-    return 0
+    from tools.graftlint import report
+
+    doc_rel = os.path.relpath(DOC, REPO)
+    try:
+        missing = check()
+    except Exception as e:  # noqa: BLE001 — a broken registry census is
+        # an internal error (rc 2), not "zero drift"
+        print(f"check_metric_docs: cannot build the registry census: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return report.EXIT_ERROR
+    return report.emit(
+        "check_metric_docs",
+        [f"{doc_rel}: [metric-docs] {name}: registered metric family "
+         "missing from the docs catalog" for name in missing],
+        ok_summary=(f"every registered metric family is documented in "
+                    f"{doc_rel}"),
+        fail_hint="Add a catalog row (docs/observability.md) for each, "
+                  "or fix the drifted name.")
 
 
 if __name__ == "__main__":

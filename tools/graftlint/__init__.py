@@ -1,0 +1,31 @@
+"""graftlint — AST static analysis for the JAX serving stack.
+
+Four invariant classes every hardening pass so far (PRs 2-4) fixed by
+hand after the fact, made regress-loudly instead:
+
+- **dispatch hygiene** — no host-device syncs on the engine step path
+  outside declared force-points (``host-sync``, ``tracer-bool``);
+- **recompile hazards** — no per-request/per-iteration jit wrappers, no
+  Python scalars in traced positions, no static-arg style drift
+  (``jit-in-loop``, ``jit-in-handler``, ``jit-scalar-arg``,
+  ``jit-static-positional``);
+- **lock discipline** — ``# guarded-by:`` annotated state accessed only
+  under its lock, and no blocking I/O inside a critical section
+  (``guarded-by``, ``lock-blocking``);
+- **fail-open handlers** — HTTP handlers answer faults, never drop the
+  connection (``handler-fail-open``); plus the ``unused-import`` sweep.
+
+Run: ``python -m tools.graftlint`` (rc 0 clean / 1 findings); regenerate
+the baseline with ``--write-baseline``. Catalog + suppression etiquette:
+``docs/static-analysis.md``. Wired into tier-1 by
+``tests/test_graftlint.py``.
+"""
+
+from tools.graftlint.core import Config, Finding  # noqa: F401
+from tools.graftlint.runner import (  # noqa: F401
+    ALL_RULES,
+    BASELINE_PATH,
+    run_lint,
+    run_passes,
+    write_baseline,
+)

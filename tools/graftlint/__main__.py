@@ -1,0 +1,90 @@
+"""``python -m tools.graftlint`` — run the suite against the repo.
+
+Report format and exit codes are shared with
+``tools/check_metric_docs.py`` (tools/graftlint/report.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if REPO not in sys.path:  # standalone `python tools/graftlint` runs
+    sys.path.insert(0, REPO)
+
+from tools.graftlint import report, runner  # noqa: E402
+from tools.graftlint.core import DEFAULT_ROOTS  # noqa: E402
+
+TOOL = "graftlint"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST static analysis for the JAX serving stack "
+                    "(dispatch hygiene, recompile hazards, lock "
+                    "discipline, fail-open handlers, unused imports).")
+    p.add_argument("roots", nargs="*", default=None,
+                   help=f"directories/files to scan (default: "
+                        f"{' '.join(DEFAULT_ROOTS)})")
+    p.add_argument("--rule", action="append", dest="rules", metavar="RULE",
+                   help="restrict to specific rule(s); may repeat. "
+                        f"Known: {', '.join(runner.ALL_RULES)}")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate baseline.toml from the current scan "
+                        "(allowlists preserved) and exit 0")
+    p.add_argument("--all", action="store_true",
+                   help="print every live finding (baselined included), "
+                        "not just new ones")
+    args = p.parse_args(argv)
+
+    roots = tuple(args.roots) if args.roots else DEFAULT_ROOTS
+    rules = set(args.rules) if args.rules else None
+    if rules:
+        unknown = rules - set(runner.ALL_RULES)
+        if unknown:
+            print(f"{TOOL}: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return report.EXIT_ERROR
+
+    if args.write_baseline:
+        if args.roots:
+            # a partial scan would silently drop every [[accepted]]
+            # entry outside the given roots — the baseline is always
+            # regenerated from the full default scan
+            print(f"{TOOL}: --write-baseline regenerates from the full "
+                  f"default scan ({' '.join(DEFAULT_ROOTS)}); drop the "
+                  "explicit roots", file=sys.stderr)
+            return report.EXIT_ERROR
+        n = runner.write_baseline()
+        print(f"{TOOL}: baseline rewritten with {n} accepted finding(s) "
+              f"at {os.path.relpath(runner.BASELINE_PATH, REPO)}")
+        return report.EXIT_OK
+
+    try:
+        fresh, stale, live, _config = runner.run_lint(roots, rules=rules)
+    except (SyntaxError, OSError) as e:
+        print(f"{TOOL}: cannot scan: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return report.EXIT_ERROR
+
+    problems = [f.render() for f in (live if args.all else fresh)]
+    problems += [f"{path}: [{rule}] {symbol}: baselined finding no "
+                 "longer fires — regenerate the baseline "
+                 "(python -m tools.graftlint --write-baseline)"
+                 for (path, rule, symbol) in stale]
+    return report.emit(
+        TOOL, problems,
+        ok_summary=(f"no new findings across {len(runner.ALL_RULES)} "
+                    f"rules ({len(live)} baselined)"),
+        fail_hint="Fix, suppress inline with a rationale "
+                  "(# graftlint: disable=<rule>), allowlist a designed "
+                  "exception, or regenerate the baseline — see "
+                  "docs/static-analysis.md.")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Name-based call graph over the scanned modules.
+
+Static reachability for the dispatch-hygiene pass ("functions reachable
+from the engine step") and the recompile pass ("per-request handlers").
+Resolution is by bare name — ``self.foo(...)``, ``obj.foo(...)`` and
+``foo(...)`` all create an edge to every known function named ``foo``.
+That over-approximates (any ``put`` reaches every ``put``), which is the
+right direction for a checker: a false edge costs a baseline entry once;
+a missed edge hides a real host-sync forever. Stdlib/third-party names
+simply resolve to nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import SourceFile
+
+
+class FunctionInfo:
+    """One function/method definition and the bare names it calls."""
+
+    __slots__ = ("sf", "node", "qualname", "calls")
+
+    def __init__(self, sf: SourceFile, node, qualname: str):
+        self.sf = sf
+        self.node = node
+        self.qualname = qualname
+        self.calls: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Name):
+                    self.calls.add(f.id)
+                elif isinstance(f, ast.Attribute):
+                    self.calls.add(f.attr)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def site(self) -> str:
+        return f"{self.sf.rel}::{self.qualname}"
+
+
+class CallGraph:
+    def __init__(self, files: list[SourceFile]):
+        self.functions: list[FunctionInfo] = []
+        self.by_name: dict[str, list[FunctionInfo]] = {}
+        self.by_qualname: dict[str, list[FunctionInfo]] = {}
+        for sf in files:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = FunctionInfo(sf, node, sf.qualname(node))
+                    self.functions.append(info)
+                    self.by_name.setdefault(node.name, []).append(info)
+                    self.by_qualname.setdefault(
+                        info.qualname, []).append(info)
+
+    def reachable_from(self, roots: list[str]) -> set[FunctionInfo]:
+        """Transitive closure from the given qualnames (exact) or bare
+        names. Nested defs are visited through their parents' walk, so
+        only top-of-chain resolution needs the name tables."""
+        seen: set[FunctionInfo] = set()
+        work: list[FunctionInfo] = []
+        for root in roots:
+            work.extend(self.by_qualname.get(root, ()))
+            if "." not in root:
+                work.extend(self.by_name.get(root, ()))
+        while work:
+            info = work.pop()
+            if info in seen:
+                continue
+            seen.add(info)
+            for callee in info.calls:
+                for target in self.by_name.get(callee, ()):
+                    if target not in seen:
+                        work.append(target)
+        return seen

@@ -1,0 +1,322 @@
+"""graftlint core: findings, suppressions, baseline, file discovery.
+
+The suite is AST-only (no imports of the code under analysis), so it
+runs in tier-1 in well under a second and can never be broken by a
+missing accelerator backend. Three suppression mechanisms, from most to
+least local:
+
+- **inline** — ``# graftlint: disable=<rule>[,<rule>...]`` on the
+  finding's line or on the enclosing ``def``/``class`` line silences
+  those rules for that line / that whole function.
+- **allowlist** — ``[allow]`` in ``baseline.toml``: per-rule lists of
+  ``path::Qual.Name`` symbols that are *designed* exceptions (the
+  engine's explicit device-sync force-points, the profiler's
+  hold-the-lock-while-sleeping semantics). Allowlisted sites are not
+  findings at all and never appear in the baseline.
+- **baseline** — ``[[accepted]]`` entries in ``baseline.toml``:
+  existing findings accepted at adoption time, keyed by
+  ``(file, rule, symbol)`` with a count. New findings (or a count
+  increase) fail the run; fixing a baselined finding without
+  regenerating the baseline also fails (stale entry), so the file can
+  only shrink toward zero. Regenerate with
+  ``python -m tools.graftlint --write-baseline``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: directories scanned by default (repo-relative)
+DEFAULT_ROOTS = ("llm_in_practise_tpu", "tools", "examples")
+
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``symbol`` is the enclosing dotted qualname
+    (``Class.method``, ``function``, or ``<module>``) — the baseline
+    keys on it instead of the line number so unrelated edits above a
+    finding don't invalidate the baseline."""
+
+    path: str          # repo-relative, forward slashes
+    line: int
+    rule: str
+    symbol: str
+    msg: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.symbol}: {self.msg}"
+
+
+class SourceFile:
+    """One parsed module: AST + parent links + comment-derived
+    suppression tables, shared by every pass."""
+
+    def __init__(self, path: str, rel: str, text: str | None = None):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.split("\n")
+        self.tree = ast.parse(text, filename=rel)
+        # parent links let passes walk outward (e.g. "is this access
+        # inside a `with self._lock` block?")
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # line -> set of disabled rules (from `# graftlint: disable=`)
+        self.disabled: dict[int, set[str]] = {}
+        self._scan_comments()
+        # line -> raw comment text (the locks pass reads `guarded-by:`)
+        # populated lazily by comment_on()
+
+    def _scan_comments(self) -> None:
+        import io
+
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _DISABLE_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    self.disabled.setdefault(tok.start[0], set()).update(rules)
+        except tokenize.TokenError:  # pragma: no cover - unparsable tail
+            pass
+
+    def comment_on(self, lineno: int) -> str:
+        """The raw text of line ``lineno`` (1-based) — passes regex it
+        for structured comments like ``# guarded-by: <lock>``."""
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def enclosing(self, node: ast.AST):
+        """Innermost enclosing FunctionDef/AsyncFunctionDef/ClassDef."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted qualname of the innermost function/class enclosing
+        ``node`` (or containing it, if ``node`` is itself a def)."""
+        names = []
+        cur = node
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+        cur = self.parents.get(cur)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(names)) or "<module>"
+
+    def suppressed(self, rule: str, node: ast.AST) -> bool:
+        """True when ``rule`` is disabled on the node's line or on any
+        enclosing def/class line."""
+        line = getattr(node, "lineno", 0)
+        if rule in self.disabled.get(line, ()):  # same line
+            return True
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                if rule in self.disabled.get(cur.lineno, ()):
+                    return True
+            cur = self.parents.get(cur)
+        return False
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+
+def discover(roots=DEFAULT_ROOTS, repo: str = REPO) -> list[SourceFile]:
+    """Parse every ``*.py`` under ``roots`` (skipping caches and this
+    linter's own fixtures). Unparsable files are reported as findings
+    by the runner, not crashes."""
+    out: list[SourceFile] = []
+    for root in roots:
+        base = os.path.join(repo, root)
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(SourceFile(base, os.path.relpath(base, repo)))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, name)
+                out.append(SourceFile(full, os.path.relpath(full, repo)))
+    return out
+
+
+# --- attribute-chain helpers shared by the passes ---------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Last path segment of the callee (``jnp.asarray`` -> ``asarray``)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def is_self_attr(node: ast.AST, attr: str | None = None) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+# --- baseline / config (TOML) -----------------------------------------------
+
+
+def _load_toml(path: str) -> dict:
+    try:
+        import tomllib as _toml  # py311+
+    except ImportError:
+        import tomli as _toml  # the image bakes tomli in
+    with open(path, "rb") as f:
+        return _toml.load(f)
+
+
+@dataclasses.dataclass
+class Config:
+    """Parsed ``baseline.toml``: allowlists + accepted findings."""
+
+    #: rule -> set of "path::symbol" designed exceptions
+    allow: dict[str, set[str]]
+    #: (path, rule, symbol) -> accepted count
+    accepted: dict[tuple[str, str, str], int]
+    #: handler-pass callables assumed fail-contained
+    safe_calls: set[str]
+    path: str | None = None
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        data = _load_toml(path) if os.path.exists(path) else {}
+        allow = {rule: set(symbols)
+                 for rule, symbols in (data.get("allow") or {}).items()}
+        accepted: dict[tuple[str, str, str], int] = {}
+        for ent in data.get("accepted") or []:
+            key = (ent["file"], ent["rule"], ent["symbol"])
+            accepted[key] = accepted.get(key, 0) + int(ent.get("count", 1))
+        safe = set((data.get("handlers") or {}).get("safe_calls") or [])
+        return cls(allow=allow, accepted=accepted, safe_calls=safe,
+                   path=path)
+
+    def allowed(self, finding: Finding) -> bool:
+        sites = self.allow.get(finding.rule)
+        return bool(sites) and f"{finding.path}::{finding.symbol}" in sites
+
+
+def _toml_str(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def render_baseline(config: Config, findings: list[Finding],
+                    prelude: str | None = None) -> str:
+    """Serialize allowlists + the given findings back to baseline.toml
+    (restricted schema — hand-rolled writer, read by tomli).
+    ``prelude``: the existing file's hand-maintained head (everything
+    before the first ``[[accepted]]``) — passed by ``--write-baseline``
+    so the allowlist rationale comments survive regeneration."""
+    if prelude is not None:
+        out = [prelude.rstrip(), ""]
+    else:
+        out = ["# graftlint baseline — regenerate with:",
+               "#   python -m tools.graftlint --write-baseline",
+               "# [allow] entries are hand-maintained designed exceptions;",
+               "# [[accepted]] entries are grandfathered findings and "
+               "should",
+               "# only ever shrink. See docs/static-analysis.md.",
+               ""]
+        if config.safe_calls:
+            out.append("[handlers]")
+            out.append("safe_calls = [")
+            for name in sorted(config.safe_calls):
+                out.append(f"    {_toml_str(name)},")
+            out.append("]")
+            out.append("")
+        if config.allow:
+            out.append("[allow]")
+            for rule in sorted(config.allow):
+                out.append(f"{_toml_str(rule)} = [")
+                for site in sorted(config.allow[rule]):
+                    out.append(f"    {_toml_str(site)},")
+                out.append("]")
+            out.append("")
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    for (path, rule, symbol) in sorted(counts):
+        out.append("[[accepted]]")
+        out.append(f"file = {_toml_str(path)}")
+        out.append(f"rule = {_toml_str(rule)}")
+        out.append(f"symbol = {_toml_str(symbol)}")
+        out.append(f"count = {counts[(path, rule, symbol)]}")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def diff_against_baseline(
+    config: Config, findings: list[Finding],
+) -> tuple[list[Finding], list[tuple[str, str, str]]]:
+    """(new findings beyond the accepted counts, stale baseline keys).
+
+    Stale keys — baselined findings that no longer fire — fail the run
+    too: the baseline must track reality or it rots into a blanket
+    waiver."""
+    live: dict[tuple[str, str, str], list[Finding]] = {}
+    for f in findings:
+        live.setdefault(f.key(), []).append(f)
+    fresh: list[Finding] = []
+    for key, group in sorted(live.items()):
+        extra = len(group) - config.accepted.get(key, 0)
+        if extra > 0:
+            fresh.extend(group[:extra])
+    stale = [key for key, n in sorted(config.accepted.items())
+             if len(live.get(key, ())) < n]
+    return fresh, stale

@@ -1,0 +1,102 @@
+"""Pass 4 — fail-open handlers.
+
+``handler-fail-open``: an HTTP handler method (``do_GET``/``do_POST``)
+that calls a non-trivial callable outside any ``try`` that catches
+``Exception``. The stack's contract (PR 3/4 hardening) is that a
+handler fault answers the client — a 500 JSON body, an in-band SSE
+error event — and books its span/metrics; an uncaught exception instead
+unwinds into socketserver, which drops the connection and prints a
+traceback nobody scrapes. Scrape callbacks get the same protection
+centrally: ``serve_obs_get`` wraps the metrics render, so a broken
+registry callback answers 500 instead of killing the scrape connection.
+
+Callables assumed fail-contained (``[handlers] safe_calls`` in
+baseline.toml, plus the built-ins below): the JsonHandler reply helpers,
+the shared obs-triplet servers, and parse-never-raise utilities.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Config, Finding, SourceFile, call_name
+
+HANDLER_METHODS = ("do_GET", "do_POST", "do_PUT", "do_DELETE")
+
+#: always-safe callees: reply helpers (send a response, documented
+#: fail-contained), stdlib never-raise-on-our-inputs utilities, and
+#: benign builtins
+_BUILTIN_SAFE = {
+    "_json", "_text", "_reply", "_read_json", "_sse", "_send",
+    "serve_obs_get", "serve_obs_post",
+    "send_response", "send_header", "end_headers",
+    "get", "bool", "str", "int", "len", "isinstance", "print", "type",
+    # str.encode / json.dumps over data this process built cannot fail
+    # in ways a try would improve; flagging them buries real findings
+    "encode", "dumps",
+    "parse_traceparent",
+}
+
+
+def _try_catches_exception(node: ast.Try) -> bool:
+    for h in node.handlers:
+        if h.type is None:
+            return True
+        names = []
+        if isinstance(h.type, ast.Tuple):
+            names = [getattr(t, "id", getattr(t, "attr", ""))
+                     for t in h.type.elts]
+        else:
+            names = [getattr(h.type, "id", getattr(h.type, "attr", ""))]
+        if any(n in ("Exception", "BaseException") for n in names):
+            return True
+    return False
+
+
+def run(files: list[SourceFile], config: Config) -> list[Finding]:
+    safe = _BUILTIN_SAFE | config.safe_calls
+    findings: list[Finding] = []
+    for sf in files:
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for method in cls.body:
+                if not isinstance(method, ast.FunctionDef):
+                    continue
+                if method.name not in HANDLER_METHODS:
+                    continue
+                findings.extend(_check_handler(sf, cls, method, safe))
+    return findings
+
+
+def _check_handler(sf: SourceFile, cls: ast.ClassDef,
+                   method: ast.FunctionDef, safe: set[str]) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(method):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None or name in safe:
+            continue
+        covered = False
+        cur = node
+        while cur is not None and cur is not method:
+            parent = sf.parents.get(cur)
+            if (isinstance(parent, ast.Try) and cur in parent.body
+                    and _try_catches_exception(parent)):
+                covered = True
+                break
+            cur = parent
+        if covered:
+            continue
+        if sf.suppressed("handler-fail-open", node):
+            continue
+        out.append(Finding(
+            sf.rel, node.lineno, "handler-fail-open",
+            f"{cls.name}.{method.name}",
+            f"call to {name}() in an HTTP handler outside any "
+            "`except Exception` — a fault here drops the connection "
+            "instead of answering 500; wrap the dispatch in try/except "
+            "or add the callee to [handlers] safe_calls if it is "
+            "fail-contained by design"))
+    return out

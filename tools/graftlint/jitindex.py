@@ -1,0 +1,162 @@
+"""Index of jit-wrapped callables — shared by the dispatch and
+recompile passes.
+
+Understands the repo's three jit idioms:
+
+- ``self._decode = jax.jit(self._decode_fn, ...)`` (possibly wrapped:
+  ``self._decode = _c(jax.jit(...))`` — the meter/compile-meter wrap),
+- ``fn = jax.jit(fn)`` / module-level ``jitted = jax.jit(fn, ...)``,
+- ``@jax.jit`` / ``@partial(jax.jit, static_argnums=...)`` decorators.
+
+For each wrap it records the *target* function (when it resolves inside
+the scanned files) and the declared static argument names/positions, so
+call-site checks can tell a static ``n=n`` from a traced scalar.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.graftlint.core import SourceFile, dotted
+
+
+def _is_jax_jit(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    return d in ("jax.jit", "jit", "jax.pjit", "pjit")
+
+
+def _const_str_tuple(node) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _const_int_tuple(node) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(elt.value for elt in node.elts
+                     if isinstance(elt, ast.Constant)
+                     and isinstance(elt.value, int))
+    return ()
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One ``jax.jit(...)`` wrap."""
+
+    sf: SourceFile
+    call: ast.Call                 # the jax.jit(...) node
+    target_name: str | None       # bare name of the wrapped function
+    owner_class: str | None       # class whose attr holds the wrapper
+    bound_attr: str | None        # e.g. "_decode" for self._decode = ...
+    static_argnames: tuple[str, ...]
+    static_argnums: tuple[int, ...]
+
+
+def _find_jit_call(node) -> ast.Call | None:
+    """The jax.jit call inside an expression (unwraps ``_c(jax.jit(...))``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _is_jax_jit(sub):
+            return sub
+    return None
+
+
+def _jit_params(call: ast.Call) -> tuple[tuple[str, ...], tuple[int, ...]]:
+    names: tuple[str, ...] = ()
+    nums: tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = _const_str_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            nums = _const_int_tuple(kw.value)
+    return names, nums
+
+
+class JitIndex:
+    def __init__(self, files: list[SourceFile]):
+        self.sites: list[JitSite] = []
+        #: (class_name, attr) -> JitSite for self.<attr> = ...jit...
+        self.bound: dict[tuple[str, str], JitSite] = {}
+        #: function defs that ARE the jitted body (for tracer-bool)
+        self.jitted_defs: list[tuple[SourceFile, ast.FunctionDef, JitSite]] = []
+        for sf in files:
+            self._scan(sf)
+        self._resolve_defs(files)
+
+    def _scan(self, sf: SourceFile) -> None:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                call = _find_jit_call(node.value)
+                if call is None:
+                    continue
+                names, nums = _jit_params(call)
+                target_name = None
+                if call.args:
+                    d = dotted(call.args[0])
+                    if d:
+                        target_name = d.rsplit(".", 1)[-1]
+                owner = None
+                bound = None
+                encl = sf.enclosing(node)
+                # climb to the class: self.X = ... appears in methods
+                cls = encl
+                while cls is not None and not isinstance(cls, ast.ClassDef):
+                    cls = sf.enclosing(cls)
+                for tgt in node.targets:
+                    d = dotted(tgt)
+                    if d and d.startswith("self.") and cls is not None:
+                        owner, bound = cls.name, d.split(".", 1)[1]
+                    elif isinstance(tgt, ast.Name):
+                        bound = tgt.id
+                site = JitSite(sf, call, target_name, owner, bound,
+                               names, nums)
+                self.sites.append(site)
+                if owner and bound:
+                    self.bound[(owner, bound)] = site
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    call = None
+                    if isinstance(dec, ast.Call) and _is_jax_jit(dec):
+                        call = dec
+                    elif isinstance(dec, ast.Call):
+                        # @partial(jax.jit, ...) — jit is the first arg
+                        if dec.args and dotted(dec.args[0]) in ("jax.jit",
+                                                                "jit"):
+                            call = dec
+                    elif dotted(dec) in ("jax.jit", "jit"):
+                        call = ast.Call(func=dec, args=[], keywords=[])
+                    if call is None:
+                        continue
+                    names, nums = _jit_params(call)
+                    site = JitSite(sf, call if isinstance(call, ast.Call)
+                                   else None, node.name, None, node.name,
+                                   names, nums)
+                    self.sites.append(site)
+                    self.jitted_defs.append((sf, node, site))
+
+    def _resolve_defs(self, files: list[SourceFile]) -> None:
+        """Match each wrap's target name to a def in the same file so
+        tracer-bool can inspect the jitted body."""
+        by_file: dict[SourceFile, dict[str, ast.FunctionDef]] = {}
+        for sf in files:
+            table: dict[str, ast.FunctionDef] = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.FunctionDef):
+                    table[node.name] = node
+            by_file[sf] = table
+        seen = {id(d) for _, d, _ in self.jitted_defs}
+        for site in self.sites:
+            if site.target_name is None:
+                continue
+            target = by_file.get(site.sf, {}).get(site.target_name)
+            if target is not None and id(target) not in seen:
+                seen.add(id(target))
+                self.jitted_defs.append((site.sf, target, site))

@@ -1,0 +1,237 @@
+"""Pass 3 — lock discipline.
+
+Two rules over a lightweight ``# guarded-by: <lockattr>`` convention
+(the annotation lives on the attribute's assignment in ``__init__`` or
+on a dataclass field line; see docs/static-analysis.md for etiquette —
+seed it on read-modify-write state and multi-field invariants, not on
+monotone counters published for lock-free scraping):
+
+- ``guarded-by`` — an annotated attribute accessed outside a
+  ``with self.<lock>:`` block in its own class. ``__init__`` is exempt
+  (construction is single-threaded by contract), as is any method whose
+  name ends in ``_locked`` (the repo's caller-holds-the-lock idiom:
+  ``_sweep_handoff_locked`` et al.).
+- ``lock-blocking`` — a blocking call (sleep, socket/HTTP I/O, device
+  dispatch or device->host transfer, thread join) issued while lexically
+  inside a ``with self.<lock>:`` block. Exactly the races PRs 2-4 fixed
+  by review: a dead pool server turning a metrics scrape into a
+  connect-timeout stall because both shared a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import (
+    Finding,
+    SourceFile,
+    call_name,
+    dotted,
+    is_self_attr,
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w]*)")
+
+#: dotted callees that block
+_BLOCKING_CALLS = {
+    "time.sleep": "sleeps",
+    "urllib.request.urlopen": "synchronous HTTP round-trip",
+    "socket.create_connection": "TCP connect (full timeout on a dead peer)",
+    "jax.device_get": "device->host transfer",
+    "jax.block_until_ready": "blocks on device completion",
+    "entry_to_host": "device->host KV copy",
+    "entry_to_device": "host->device KV upload",
+}
+
+#: attribute method names that block regardless of receiver
+_BLOCKING_METHODS = {
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "urlopen": "synchronous HTTP round-trip",
+    "sleep": "sleeps",
+}
+
+#: method names that block only on thread/queue-ish receivers; matching
+#: on the bare name would flood (str.join), so require the receiver
+#: attribute/name to look like a thread or queue
+_BLOCKING_JOINISH = ("thread", "worker", "queue", "publisher")
+
+
+def _guarded_attrs(sf: SourceFile, cls: ast.ClassDef) -> dict[str, str]:
+    """{attr: lockattr} from ``# guarded-by:`` comments on ``self.X =``
+    assignments in methods and on class-level (dataclass) field lines."""
+    out: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not _owned(sf, cls, node):
+            continue
+        attr = None
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if is_self_attr(tgt):
+                    attr = tgt.attr
+                elif isinstance(tgt, ast.Name) and sf.enclosing(node) is cls:
+                    attr = tgt.id
+        elif isinstance(node, ast.AnnAssign):
+            if is_self_attr(node.target):
+                attr = node.target.attr
+            elif (isinstance(node.target, ast.Name)
+                  and sf.enclosing(node) is cls):
+                attr = node.target.id
+        if attr is None:
+            continue
+        m = _GUARDED_RE.search(sf.comment_on(node.lineno))
+        if m:
+            out[attr] = m.group(1)
+    return out
+
+
+def _with_locks(sf: SourceFile, node: ast.AST) -> set[str]:
+    """Lock attribute names held at ``node``: every enclosing
+    ``with self.<name>:`` (or ``with <name>:`` for module-level locks)."""
+    held: set[str] = set()
+    for anc in sf.ancestors(node):
+        if not isinstance(anc, ast.With):
+            continue
+        for item in anc.items:
+            expr = item.context_expr
+            # unwrap common call forms: with self._lock: / with lock:
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+                # with self._lock.acquire()-style is not the idiom here
+                if isinstance(expr, ast.Attribute) and expr.attr in (
+                        "acquire",):
+                    expr = expr.value
+            if is_self_attr(expr):
+                held.add(expr.attr)
+            elif isinstance(expr, ast.Name):
+                held.add(expr.id)
+            elif isinstance(expr, ast.Attribute):
+                d = dotted(expr)
+                if d:
+                    held.add(d.split(".")[-1])
+    return held
+
+
+def _method_of(sf: SourceFile, node: ast.AST) -> ast.FunctionDef | None:
+    cur = sf.parents.get(node)
+    fn = None
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = cur  # keep climbing: want the OUTERMOST def in the class
+        if isinstance(cur, ast.ClassDef):
+            return fn
+        cur = sf.parents.get(cur)
+    return None
+
+
+def _innermost_class(sf: SourceFile, node: ast.AST) -> ast.ClassDef | None:
+    cur = sf.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = sf.parents.get(cur)
+    return None
+
+
+def _owned(sf: SourceFile, cls: ast.ClassDef, node: ast.AST) -> bool:
+    """True when ``node``'s innermost enclosing class IS ``cls`` —
+    ``ast.walk(cls)`` descends into nested classes (the stack's
+    ubiquitous ``class Handler`` inside ``make_handler``), whose
+    ``self`` is a DIFFERENT object: checking its accesses against the
+    outer class's guarded map is wrong, and reporting its findings
+    under both classes double-counts them."""
+    return _innermost_class(sf, node) is cls
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(sf, cls)
+            if guarded:
+                findings.extend(_check_guarded(sf, cls, guarded))
+            findings.extend(_check_blocking(sf, cls))
+    return findings
+
+
+def _check_guarded(sf: SourceFile, cls: ast.ClassDef,
+                   guarded: dict[str, str]) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Attribute) and is_self_attr(node)):
+            continue
+        if not _owned(sf, cls, node):
+            continue  # a nested class's self is a different object
+        lock = guarded.get(node.attr)
+        if lock is None:
+            continue
+        method = _method_of(sf, node)
+        if method is None:
+            continue  # class-level (the annotation line itself)
+        if method.name == "__init__" or method.name.endswith("_locked"):
+            continue
+        if lock in _with_locks(sf, node):
+            continue
+        if sf.suppressed("guarded-by", node):
+            continue
+        kind = ("write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                or _is_augtarget(sf, node) else "read")
+        out.append(Finding(
+            sf.rel, node.lineno, "guarded-by",
+            f"{cls.name}.{method.name}",
+            f"{kind} of self.{node.attr} outside `with self.{lock}` "
+            f"(declared guarded-by: {lock}); hold the lock, move the "
+            "access into a *_locked helper, or suppress with a "
+            "rationale"))
+    return out
+
+
+def _is_augtarget(sf: SourceFile, node: ast.AST) -> bool:
+    parent = sf.parents.get(node)
+    return isinstance(parent, ast.AugAssign) and parent.target is node
+
+
+def _check_blocking(sf: SourceFile, cls: ast.ClassDef) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _owned(sf, cls, node):
+            continue  # nested classes get their own _check_blocking pass
+        held = _with_locks(sf, node)
+        held = {h for h in held if "lock" in h.lower()}
+        if not held:
+            continue
+        d = dotted(node.func)
+        name = call_name(node)
+        why = None
+        if d in _BLOCKING_CALLS:
+            why = _BLOCKING_CALLS[d]
+        elif (isinstance(node.func, ast.Attribute)
+              and name in _BLOCKING_METHODS):
+            why = _BLOCKING_METHODS[name]
+        elif (isinstance(node.func, ast.Attribute) and name == "join"
+              and not node.args):  # str.join always takes an iterable
+            recv = dotted(node.func.value) or ""
+            if any(t in recv.lower() for t in _BLOCKING_JOINISH):
+                why = "blocking join"
+        elif name and name.startswith("request") and d and d.startswith(
+                "requests."):
+            why = "synchronous HTTP round-trip"
+        if why is None:
+            continue
+        if sf.suppressed("lock-blocking", node):
+            continue
+        lock = sorted(held)[0]
+        out.append(Finding(
+            sf.rel, node.lineno, "lock-blocking",
+            f"{cls.name}.{(_method_of(sf, node) or cls).name}",
+            f"blocking call ({why}) while holding {lock} — every other "
+            "thread contending this lock stalls for the full I/O; move "
+            "the call outside the critical section or suppress with the "
+            "design rationale"))
+    return out

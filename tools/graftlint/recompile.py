@@ -1,0 +1,162 @@
+"""Pass 2 — recompile hazards.
+
+A serving-time retrace/recompile is a multi-second latency cliff
+(``llm_compile_seconds_total`` exists to surface it; this pass exists to
+prevent it). Rules:
+
+- ``jit-in-loop`` — a ``jax.jit(...)`` wrapper constructed inside a
+  ``for``/``while`` body: every iteration builds a fresh callable with a
+  fresh compilation cache, so nothing is ever reused.
+- ``jit-in-handler`` — a ``jax.jit(...)`` constructed in a function
+  reachable from a per-request HTTP handler (``do_GET``/``do_POST``/
+  ``handle_*``): per-request wrappers recompile per request. Lazily
+  built, *cached* wrappers are fine — suppress inline with the cache
+  cited (see api.py's embeddings pooler).
+- ``jit-scalar-arg`` — a known jitted callable invoked with a bare
+  Python number/tuple literal in a traced position. Python scalars are
+  weakly-typed leaves: each distinct value/type hashes to a new
+  signature and can retrace; pass ``jnp.asarray(x)`` or declare the
+  parameter static.
+- ``jit-static-positional`` — one jitted callable whose declared-static
+  parameter is passed by keyword at some call sites and positionally at
+  others. Mixed styles are how static_argnums drift slips in: a later
+  signature edit re-numbers the positional sites while the keyword
+  sites keep working, and the renumbered arg silently lands in a traced
+  slot. Pick one style per callable (keyword, preferably).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.callgraph import CallGraph
+from tools.graftlint.core import Finding, SourceFile, dotted
+from tools.graftlint.jitindex import JitIndex, _is_jax_jit
+
+HANDLER_ROOTS = ("do_GET", "do_POST", "handle", "handle_chat",
+                 "handle_completion", "handle_prefill",
+                 "handle_embeddings")
+
+
+def _class_of(sf: SourceFile, node: ast.AST) -> str | None:
+    cur = node
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = sf.parents.get(cur)
+    return None
+
+
+def run(files: list[SourceFile], graph: CallGraph,
+        jit_index: JitIndex) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # jit-in-loop + jit-in-handler ------------------------------------------
+    handler_funcs = graph.reachable_from(list(HANDLER_ROOTS))
+    handler_nodes = {id(info.node) for info in handler_funcs}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_jax_jit(node)):
+                continue
+            in_loop = any(isinstance(a, (ast.For, ast.While))
+                          for a in sf.ancestors(node))
+            if in_loop and not sf.suppressed("jit-in-loop", node):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "jit-in-loop", sf.qualname(node),
+                    "jax.jit wrapper constructed inside a loop — each "
+                    "iteration gets a fresh compilation cache; hoist the "
+                    "wrapper out of the loop"))
+            encl = sf.enclosing(node)
+            in_handler = False
+            cur = encl
+            while cur is not None:
+                if id(cur) in handler_nodes:
+                    in_handler = True
+                    break
+                cur = sf.enclosing(cur)
+            if in_handler and not sf.suppressed("jit-in-handler", node):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "jit-in-handler",
+                    sf.qualname(node),
+                    "jax.jit wrapper constructed on a per-request handler "
+                    "path — recompiles per request unless cached; cache "
+                    "the wrapper and suppress inline citing the cache"))
+
+    # call-site checks over known jitted attrs ------------------------------
+    # (cls, attr, static_param) -> {"kw": [...call nodes...], "pos": [...]}
+    styles: dict[tuple, dict[str, list]] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d or not d.startswith("self."):
+                continue
+            attr = d.split(".", 1)[1]
+            cls = _class_of(sf, node)
+            site = jit_index.bound.get((cls, attr)) if cls else None
+            if site is None:
+                continue
+            static_names = set(site.static_argnames)
+            # scalar/tuple literals in traced positions
+            for i, arg in enumerate(node.args):
+                bad = (isinstance(arg, ast.Constant)
+                       and isinstance(arg.value, (int, float, bool))
+                       ) or isinstance(arg, ast.Tuple)
+                if bad and not sf.suppressed("jit-scalar-arg", node):
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "jit-scalar-arg",
+                        sf.qualname(node),
+                        f"jitted self.{attr} called with a Python "
+                        f"{'tuple' if isinstance(arg, ast.Tuple) else 'scalar'} "
+                        f"literal in traced position {i} — wrap in "
+                        "jnp.asarray(...) or declare the param static"))
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in static_names:
+                    continue
+                bad = (isinstance(kw.value, ast.Constant)
+                       and isinstance(kw.value.value, (int, float, bool))
+                       ) or isinstance(kw.value, ast.Tuple)
+                if bad and not sf.suppressed("jit-scalar-arg", node):
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "jit-scalar-arg",
+                        sf.qualname(node),
+                        f"jitted self.{attr} called with a Python literal "
+                        f"for non-static keyword {kw.arg!r} — wrap in "
+                        "jnp.asarray(...) or add it to static_argnames"))
+            # record per-static-param passing style for the drift check
+            if static_names and site.target_name:
+                target = None
+                for fn_sf, fn, _fsite in jit_index.jitted_defs:
+                    if fn_sf is site.sf and fn.name == site.target_name:
+                        target = fn
+                        break
+                if target is not None:
+                    ordered = [a.arg for a in (target.args.posonlyargs
+                                               + target.args.args)
+                               if a.arg != "self"]
+                    for pname in static_names:
+                        key = (cls, attr, pname)
+                        rec = styles.setdefault(key, {"kw": [], "pos": []})
+                        if any(kw.arg == pname for kw in node.keywords):
+                            rec["kw"].append((sf, node))
+                        elif (pname in ordered
+                              and ordered.index(pname) < len(node.args)):
+                            rec["pos"].append((sf, node))
+
+    for (cls, attr, pname), rec in sorted(
+            styles.items(), key=lambda kv: (kv[0][0] or "", kv[0][1],
+                                            kv[0][2])):
+        if not (rec["kw"] and rec["pos"]):
+            continue  # consistent across every call site
+        for sf, node in rec["pos"]:
+            if sf.suppressed("jit-static-positional", node):
+                continue
+            findings.append(Finding(
+                sf.rel, node.lineno, "jit-static-positional",
+                sf.qualname(node),
+                f"static parameter {pname!r} of self.{attr} is passed "
+                "positionally here but by keyword at other call sites — "
+                "style drift is how a signature edit silently re-binds a "
+                f"static arg into a traced slot; pass {pname}=..."))
+    return findings

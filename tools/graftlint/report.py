@@ -1,0 +1,38 @@
+"""The one CLI report/exit-code contract for the repo's checkers.
+
+``tools/check_metric_docs.py`` and ``python -m tools.graftlint`` both
+emit this shape, so tier-1 logs and CI greps read identically across
+checkers:
+
+    <tool>: <file>:<line>: [<rule>] <symbol>: <message>
+    ...
+    <tool>: FAIL — <n> problem(s). <hint>
+or
+    <tool>: OK — <summary>
+
+Exit codes: 0 clean, 1 findings, 2 internal/usage error.
+"""
+
+from __future__ import annotations
+
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_ERROR = 2
+
+
+def emit(tool: str, problems: list[str], *, ok_summary: str,
+         fail_hint: str = "", out=None) -> int:
+    """Print the standard report; returns the process exit code."""
+    import sys
+
+    out = out or sys.stdout
+    if not problems:
+        print(f"{tool}: OK — {ok_summary}", file=out)
+        return EXIT_OK
+    for line in problems:
+        print(f"{tool}: {line}", file=out)
+    tail = f"{tool}: FAIL — {len(problems)} problem(s)."
+    if fail_hint:
+        tail += f" {fail_hint}"
+    print(tail, file=out)
+    return EXIT_FINDINGS

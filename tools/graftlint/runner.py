@@ -1,0 +1,94 @@
+"""Orchestrates the graftlint passes over the repo (or any file set)."""
+
+from __future__ import annotations
+
+import os
+
+from tools.graftlint import dispatch, handlers, locks, recompile, unused
+from tools.graftlint.callgraph import CallGraph
+from tools.graftlint.core import (
+    DEFAULT_ROOTS,
+    REPO,
+    Config,
+    Finding,
+    SourceFile,
+    diff_against_baseline,
+    discover,
+    render_baseline,
+)
+from tools.graftlint.jitindex import JitIndex
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.toml")
+
+ALL_RULES = (
+    "host-sync", "tracer-bool",
+    "jit-in-loop", "jit-in-handler", "jit-scalar-arg",
+    "jit-static-positional",
+    "guarded-by", "lock-blocking",
+    "handler-fail-open",
+    "unused-import",
+)
+
+
+def run_passes(files: list[SourceFile], config: Config,
+               rules: set[str] | None = None) -> list[Finding]:
+    """Raw findings (inline suppressions applied by the passes, config
+    allowlist applied here; baseline NOT applied — see run_lint)."""
+    graph = CallGraph(files)
+    jit_index = JitIndex(files)
+    findings: list[Finding] = []
+    findings += dispatch.run(files, graph, jit_index)
+    findings += recompile.run(files, graph, jit_index)
+    findings += locks.run(files)
+    findings += handlers.run(files, config)
+    findings += unused.run(files)
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    findings = [f for f in findings if not config.allowed(f)]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def run_lint(roots=DEFAULT_ROOTS, repo: str = REPO,
+             baseline_path: str = BASELINE_PATH,
+             rules: set[str] | None = None,
+             files: list[SourceFile] | None = None):
+    """(new findings, stale baseline keys, all live findings, config).
+
+    A rule- or root-restricted run compares only against the baseline
+    entries that restriction could have produced — otherwise every
+    accepted finding of an unselected rule (or outside the scanned
+    roots) would read as stale and fail a perfectly scoped
+    ``--rule``/path invocation."""
+    config = Config.load(baseline_path)
+    if files is None:
+        files = discover(roots, repo)
+    findings = run_passes(files, config, rules)
+    scanned = {sf.rel for sf in files}
+    config.accepted = {
+        key: n for key, n in config.accepted.items()
+        if (rules is None or key[1] in rules) and key[0] in scanned
+    }
+    fresh, stale = diff_against_baseline(config, findings)
+    return fresh, stale, findings, config
+
+
+def write_baseline(roots=DEFAULT_ROOTS, repo: str = REPO,
+                   baseline_path: str = BASELINE_PATH) -> int:
+    config = Config.load(baseline_path)
+    findings = run_passes(discover(roots, repo), config)
+    prelude = None
+    if os.path.exists(baseline_path):
+        # keep the hand-maintained head ([handlers]/[allow] + their
+        # rationale comments) verbatim; regenerate only the [[accepted]]
+        # tables. Anchor on a line STARTING with the table header — the
+        # file's own comments mention "[[accepted]]" in prose.
+        import re
+
+        with open(baseline_path, encoding="utf-8") as f:
+            text = f.read()
+        m = re.search(r"(?m)^\[\[accepted\]\]", text)
+        prelude = text[: m.start()] if m else text
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        f.write(render_baseline(config, findings, prelude=prelude))
+    return len(findings)

@@ -1,0 +1,141 @@
+"""Pass 5 — unused imports (the dead-code sweep's driver).
+
+``unused-import``: a module-level or function-level import whose bound
+name is never read in the module. Conservative by design:
+
+- package ``__init__.py`` files are skipped entirely (imports there ARE
+  the public API),
+- names listed in ``__all__`` count as used,
+- ``import x as x`` / ``from y import x as x`` (the PEP 484 re-export
+  idiom) counts as used,
+- a bare ``import a.b`` binds ``a`` — any use of ``a`` keeps it,
+- ``# noqa`` on the import line is honored (shared vocabulary with
+  flake8 — the availability-probe idiom ``try: import x  # noqa``),
+- imports inside a ``try`` that catches ImportError are probe imports
+  (the import IS the use),
+- identifiers inside *string* annotations count as uses
+  (``tokens: "queue.Queue[Any]"`` keeps ``Any``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Finding, SourceFile
+
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+_NOQA_RE = re.compile(r"#\s*noqa\b", re.IGNORECASE)
+
+
+def _exported_names(tree: ast.Module) -> set[str]:
+    out: set[str] = set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                for elt in getattr(value, "elts", []):
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        out.add(elt.value)
+    return out
+
+
+def _string_annotation_names(tree: ast.Module) -> set[str]:
+    """Identifiers inside string annotations (unevaluated at runtime,
+    but deleting their imports breaks get_type_hints and the reader)."""
+    out: set[str] = set()
+    annots = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign):
+            annots.append(node.annotation)
+        elif isinstance(node, ast.arg) and node.annotation is not None:
+            annots.append(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.returns is not None:
+                annots.append(node.returns)
+    for ann in annots:
+        for sub in ast.walk(ann):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.update(_IDENT_RE.findall(sub.value))
+    return out
+
+
+def _probe_import(sf: SourceFile, node: ast.AST) -> bool:
+    """Inside a ``try`` that catches ImportError/ModuleNotFoundError."""
+    cur = node
+    for anc in sf.ancestors(node):
+        if isinstance(anc, ast.Try) and cur in anc.body:
+            for h in anc.handlers:
+                names = ([getattr(t, "id", getattr(t, "attr", ""))
+                          for t in h.type.elts]
+                         if isinstance(h.type, ast.Tuple)
+                         else [getattr(h.type, "id",
+                                       getattr(h.type, "attr", ""))]
+                         if h.type is not None else [""])
+                if any(n in ("ImportError", "ModuleNotFoundError", "")
+                       for n in names):
+                    return True
+        cur = anc
+    return False
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        if sf.rel.endswith("__init__.py"):
+            continue
+        used: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # the chain's root is a Name, already collected
+        used |= _exported_names(sf.tree)
+        used |= _string_annotation_names(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                if _NOQA_RE.search(sf.comment_on(node.lineno)):
+                    continue
+                if _probe_import(sf, node):
+                    continue
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.asname == alias.name:
+                        continue  # re-export idiom
+                    if bound in used:
+                        continue
+                    if sf.suppressed("unused-import", node):
+                        continue
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "unused-import",
+                        sf.qualname(node),
+                        f"import {alias.name!r} is never used"))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    if alias.asname == alias.name:
+                        continue  # re-export idiom
+                    if bound in used:
+                        continue
+                    if sf.suppressed("unused-import", node):
+                        continue
+                    findings.append(Finding(
+                        sf.rel, node.lineno, "unused-import",
+                        sf.qualname(node),
+                        f"from {node.module or '.'} import "
+                        f"{alias.name!r} is never used"))
+    return findings

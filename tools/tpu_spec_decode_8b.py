@@ -24,7 +24,6 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
