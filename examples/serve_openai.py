@@ -124,6 +124,28 @@ def main():
                    metavar="SECONDS",
                    help="SLO goodput accounting: per-token (TPOT) "
                         "threshold (docs/observability.md device plane)")
+    p.add_argument("--kv-layout", dest="kv_layout", default="paged",
+                   choices=["paged", "contiguous"],
+                   help="KV cache layout (docs/paged-kv.md): 'paged' "
+                        "(default) carves one pool into fixed-size "
+                        "pages behind per-slot block tables — admission "
+                        "reserves actual pages, prefixes share "
+                        "refcounted pages (COW), handoff ships only "
+                        "live pages (vLLM PagedAttention parity); "
+                        "'contiguous' is the previous slot-owns-a-"
+                        "cache_len-region layout, kept as a fallback "
+                        "for one release (golden tokens are identical)")
+    p.add_argument("--kv-page-size", dest="kv_page_size", type=int,
+                   default=16, metavar="TOKENS",
+                   help="tokens per KV page (paged layout; vLLM "
+                        "block_size parity)")
+    p.add_argument("--kv-pool-tokens", dest="kv_pool_tokens", type=int,
+                   default=None, metavar="TOKENS",
+                   help="page-pool capacity in tokens (paged layout); "
+                        "default max_slots*cache_len — set LOWER than "
+                        "that to serve more slots than worst-case "
+                        "contexts would allow, relying on page-granular "
+                        "admission + preemption")
     p.add_argument("--kv-cache-dtype", dest="kv_cache_dtype",
                    default="float32", choices=["float32", "bfloat16", "fp8"],
                    help="KV cache storage dtype; fp8 (e4m3) halves KV HBM "
@@ -160,6 +182,10 @@ def main():
         p.error(f"--role {args.role} requires --kv-remote: the KV handoff "
                 "between the prefill and decode pools travels through the "
                 "shared kv_pool server")
+    if args.scan_layers and args.kv_layout == "paged":
+        p.error("--scan-layers serves with --kv-layout contiguous only "
+                "(the paged pool supports the unrolled cache layout; "
+                "pass --kv-layout contiguous explicitly)")
     if args.draft_model_path and args.speculative is None:
         p.error("--draft-model-path requires --speculative K")
     if args.draft_model_path and args.scan_layers:
@@ -286,6 +312,9 @@ def main():
         queue_timeout_s=args.queue_timeout,
         ttft_slo_s=args.ttft_slo, tpot_slo_s=args.tpot_slo,
         draft_model=draft_model, draft_params=draft_params,
+        kv_layout=args.kv_layout,
+        kv_page_size=args.kv_page_size,
+        kv_pool_tokens=args.kv_pool_tokens,
     )
     engine = InferenceEngine(model, params,
                              kv_pool=make_kv_pool(args.model_name),

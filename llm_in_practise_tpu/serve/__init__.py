@@ -35,7 +35,15 @@ from llm_in_practise_tpu.serve.disagg import (  # noqa: F401
     RemoteHandoff,
     new_handoff_id,
 )
-from llm_in_practise_tpu.serve.prefix_cache import PrefixCache  # noqa: F401
+from llm_in_practise_tpu.serve.prefix_cache import (  # noqa: F401
+    PagedPrefixIndex,
+    PrefixCache,
+)
+from llm_in_practise_tpu.serve.paged_kv import (  # noqa: F401
+    PagedKV,
+    PagePool,
+    pages_for,
+)
 from llm_in_practise_tpu.serve.kv_pool import (  # noqa: F401
     HostKVPool,
     KVPoolServer,

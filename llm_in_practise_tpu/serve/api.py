@@ -248,6 +248,17 @@ class OpenAIServer:
                     "message": "engine is not running",
                     "type": "internal_error",
                     "code": "engine_dead"}})
+            if handle.finish_reason == "too_large":
+                outcome = "too_large"
+                detail = engine.page_capacity_detail(len(prompt_ids))
+                return send_json(422, {"error": {
+                    "message": (
+                        "prompt can never fit this replica's KV page "
+                        f"pool ({detail['pages_needed']} pages needed "
+                        f"vs {detail['pages_capacity']} capacity)"),
+                    "type": "invalid_request_error",
+                    "code": "prompt_too_large",
+                    "detail": detail}})
             if handle.finish_reason == "queue_full":
                 outcome = "queue_full"
                 return send_json(429, {"error": {
@@ -355,6 +366,24 @@ class OpenAIServer:
                     "code": "queue_full",
                 }})
 
+            # paged KV admission: a prompt that can NEVER fit the page
+            # pool (prompt pages + 1 > capacity) is a client error, not
+            # load — 422 with the page math, synchronously at submit,
+            # instead of aging into a generic queue-full 429
+            if handle.finish_reason == "too_large":
+                detail = engine.page_capacity_detail(len(prompt_ids))
+                span.end(status=422, finish_reason="too_large")
+                return send_json(422, {"error": {
+                    "message": (
+                        "prompt can never fit this replica's KV page "
+                        f"pool: {detail['pages_needed']} pages needed "
+                        f"(prompt {detail['prompt_tokens']} tokens + 1 "
+                        f"at page_size {detail['page_size']}) vs "
+                        f"{detail['pages_capacity']} pages capacity"),
+                    "type": "invalid_request_error",
+                    "code": "prompt_too_large",
+                    "detail": detail,
+                }})
             # admission control: a max_queue rejection is synchronous at
             # submit — return 429 before any stream starts (vLLM/ingress
             # backpressure parity; the gateway's retry policy keys on 429).
@@ -630,6 +659,42 @@ class OpenAIServer:
         reg.counter_func("llm_prefix_cache_tokens_saved_total",
                          _pc("tokens_saved"))
         reg.gauge_func("llm_prefix_cache_tokens", _pc("cached_tokens"))
+        if getattr(eng, "paged", None) is not None:
+            # paged KV plane (docs/paged-kv.md): occupancy is THE
+            # admission signal — free pages are admittable tokens, the
+            # shared count is prefix reuse working, and preemptions
+            # mean the pool is undersized for the offered load
+            pool = eng.paged.pool
+
+            def _pages():
+                free = pool.free_pages
+                shared = pool.shared_pages
+                return [({"state": "free"}, free),
+                        ({"state": "used"}, pool.capacity - free),
+                        ({"state": "shared"}, shared)]
+
+            reg.gauge_func("llm_kv_pages", _pages,
+                           "page-pool occupancy by state (shared = "
+                           "refcount > 1, also counted in used)")
+            reg.gauge_func("llm_kv_pages_total", lambda: pool.capacity,
+                           "allocatable pages in the pool")
+            reg.gauge_func("llm_kv_page_size",
+                           lambda: pool.page_size,
+                           "tokens per KV page")
+            reg.gauge_func(
+                "llm_kv_page_fragmentation",
+                lambda: [({}, eng.debug_kv().get("fragmentation", 0.0))],
+                "allocated-but-unfilled token slack of slot-mapped "
+                "pages (contiguous layouts waste cache_len - context "
+                "per slot; paged keeps this under one page)")
+            reg.counter_func("llm_kv_preemptions_total",
+                             lambda: eng.preemptions,
+                             "slots preempted (recompute-resume) under "
+                             "page-pool pressure")
+            reg.counter_func("llm_kv_rejected_too_large_total",
+                             lambda: eng.rejected_too_large,
+                             "prompts refused at submit: pages needed "
+                             "exceed pool capacity (HTTP 422)")
         if eng.speculative_k is not None:
             reg.counter_func("llm_spec_tokens_proposed_total",
                              lambda: eng.spec_proposed)
@@ -679,6 +744,11 @@ class OpenAIServer:
                                  server.tracer):
                     return
                 try:
+                    if self.path == "/debug/kv":
+                        # page-pool occupancy / sharing / fragmentation
+                        # / block-table sizes (docs/paged-kv.md); the
+                        # contiguous layout reports its reservation
+                        return self._json(200, server.engine.debug_kv())
                     if self.path == "/v1/models":
                         return self._json(200, {
                             "object": "list",

@@ -195,7 +195,16 @@ def usable_for_engine(host: HostEntry, prompt_ids, engine) -> str | None:
     if getattr(host, "slot_axis", 0) != engine._sax:
         return (f"cache layout mismatch: entry slot_axis "
                 f"{getattr(host, 'slot_axis', 0)} vs engine {engine._sax}")
-    if host.bucket > engine.cache_len:
-        return (f"entry bucket {host.bucket} exceeds engine cache_len "
-                f"{engine.cache_len}")
+    if getattr(engine, "paged", None) is None:
+        # a contiguous consumer inserts the FULL (post-pow2-padding)
+        # bucket width — bound that, or the scatter clamps and corrupts
+        # the slot. A PAGED consumer only scatters the first `length`
+        # positions, so any wire width is fine there.
+        from llm_in_practise_tpu.serve.kv_pool import effective_bucket
+
+        eff = effective_bucket(host)
+        if eff > engine.cache_len:
+            return (f"entry width {eff} (wire {host.bucket}, pow2-"
+                    f"padded for the contiguous insert) exceeds engine "
+                    f"cache_len {engine.cache_len}")
     return None

@@ -103,6 +103,19 @@ class Request:
     kv_entry: object | None = dataclasses.field(
         default=None, repr=False, compare=False)
     handoff_id: str | None = None
+    # Paged-KV preemption (serve/paged_kv.py): when the page pool
+    # exhausts mid-decode, the youngest slot is preempted BY RECOMPUTE —
+    # its request re-enters the queue with ``prompt_ids`` extended to
+    # everything already emitted, ``resume_last`` holding the one token
+    # whose KV is not yet written, and ``resume_budget`` the remaining
+    # token budget. Re-admission prefills the extended prompt (usually a
+    # page-index hit — the preempted pages were registered) and resumes
+    # decoding WITHOUT emitting or re-sampling; the client stream never
+    # notices beyond the latency bubble.
+    resume_last: int | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    resume_budget: int = dataclasses.field(
+        default=0, repr=False, compare=False)
     # request tracing (obs/trace.py): the TraceContext the API layer
     # minted for this request — the engine parents its queue-wait /
     # admission / prefill-chunk / decode / handoff-publish spans here,
@@ -241,6 +254,9 @@ class InferenceEngine:
         tracer=None,
         ttft_slo_s: float | None = None,
         tpot_slo_s: float | None = None,
+        kv_layout: str = "contiguous",
+        kv_page_size: int = 16,
+        kv_pool_tokens: int | None = None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -278,10 +294,41 @@ class InferenceEngine:
         )
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-        self.cache = model.init_cache(max_slots, self.cache_len, dtype=cache_dtype)
-        self._vectorize_cache_index()
-        if mesh is not None:
-            self.cache = jax.device_put(self.cache, self._cache_shardings())
+        # KV layout (ROADMAP item 2 / docs/paged-kv.md): "contiguous" is
+        # the original slot-owns-a-cache_len-region buffer; "paged"
+        # carves one flat pool into fixed-size pages behind per-slot
+        # block tables (vLLM PagedAttention idiom) — admission reserves
+        # actual pages instead of worst-case context, prefixes share
+        # refcounted pages, and handoff/tiering move page-aligned rows.
+        # Golden tokens are layout-invariant (tests/test_paged_kv.py);
+        # "contiguous" remains the fallback for one release.
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'contiguous', got "
+                f"{kv_layout!r}")
+        self.paged = None
+        if kv_layout == "paged":
+            from llm_in_practise_tpu.serve.paged_kv import PagedKV
+
+            self.paged = PagedKV(
+                model, max_slots=max_slots, cache_len=self.cache_len,
+                page_size=kv_page_size,
+                pool_tokens=(kv_pool_tokens if kv_pool_tokens is not None
+                             else max_slots * self.cache_len),
+                dtype=cache_dtype, mesh=mesh)
+            # no contiguous engine cache exists in this layout; the
+            # jitted paged programs gather transient views from the pool
+            self.cache = None
+        else:
+            self.cache = model.init_cache(max_slots, self.cache_len,
+                                          dtype=cache_dtype)
+            self._vectorize_cache_index()
+            if mesh is not None:
+                self.cache = jax.device_put(self.cache,
+                                            self._cache_shardings())
+        self.preemptions = 0            # paged pool-pressure preemptions
+        self.rejected_too_large = 0     # prompts that can NEVER fit the pool
+        self._paged_admit_blocked = False
 
         # Host-side slot table (slot_len mirrors the device cache index so
         # finish checks never force a device sync).
@@ -337,15 +384,43 @@ class InferenceEngine:
         self._thread: threading.Thread | None = None
 
         # Prefix caching (vLLM APC parity): True -> default-sized cache.
-        from llm_in_practise_tpu.serve.prefix_cache import PrefixCache
+        from llm_in_practise_tpu.serve.prefix_cache import (
+            PagedPrefixIndex,
+            PrefixCache,
+        )
 
-        if prefix_cache is True or (not prefix_cache and kv_pool is not None):
+        if self.paged is not None:
+            # Paged engines share PHYSICAL PAGES instead of copying
+            # rows: the L1 "cache" is the hash-per-page index over the
+            # pool itself (partial-prefix hits at page granularity,
+            # refcounted COW sharing — see prefix_cache.PagedPrefixIndex).
+            # A row-based PrefixCache instance passed in is replaced;
+            # its budget knobs carry over.
+            want = bool(prefix_cache) or kv_pool is not None
+            idx = None
+            if want:
+                kwargs = {}
+                if isinstance(prefix_cache, PrefixCache):
+                    kwargs = dict(max_tokens=prefix_cache.max_tokens,
+                                  min_prefix=prefix_cache.min_prefix)
+                idx = PagedPrefixIndex(self.paged.pool, **kwargs)
+                # admission pressure reclaims cold shared prefixes
+                # before it preempts anybody
+                self.paged.pool.reclaim = idx.evict_pages
+            self.prefix_cache = idx
+        elif prefix_cache is True or (not prefix_cache
+                                      and kv_pool is not None):
             prefix_cache = PrefixCache()
-        self.prefix_cache = prefix_cache or None
+            self.prefix_cache = prefix_cache
+        else:
+            self.prefix_cache = prefix_cache or None
         # Tiered offload (LMCache parity): L1 evictions flow into the
         # host/remote pool instead of vanishing; lookups cascade back up.
+        # (Paged engines populate the tiers by write-through only: an
+        # evicted shared page has no token-tuple key of its own.)
         self.kv_pool = kv_pool
-        if kv_pool is not None and self.prefix_cache is not None:
+        if (kv_pool is not None and self.prefix_cache is not None
+                and self.paged is None):
             prior = self.prefix_cache.on_evict
             def _evict(key, entry, _prior=prior):
                 if _prior is not None:
@@ -544,9 +619,34 @@ class InferenceEngine:
                                        donate_argnums=(1,)))
         self._slot_rows = _c(jax.jit(self._slot_rows_fn,
                                      static_argnames=("bucket",)))
-        self._mixed = _c(jax.jit(make_mixed_step(model),
+        self._mixed_raw = make_mixed_step(model)
+        self._mixed = _c(jax.jit(self._mixed_raw,
                                  donate_argnums=(1,),
                                  static_argnames=("n",)))
+        if self.paged is not None:
+            # Paged twins of the engine programs: same RAW bodies (the
+            # math that pins golden parity) between a page gather and a
+            # window scatter, one dispatch each — see the "jitted
+            # pieces, paged" section. The pool is donated so updates
+            # are in place; the contiguous view is a transient XLA
+            # frees between dispatches.
+            self._pg_decode = _c(jax.jit(self._paged_decode_fn,
+                                         donate_argnums=(1,)))
+            self._pg_multi = _c(jax.jit(self._paged_multi_fn,
+                                        donate_argnums=(1,),
+                                        static_argnames=("n",)))
+            self._pg_spec = _c(jax.jit(self._paged_spec_fn,
+                                       donate_argnums=(1,)))
+            self._pg_chunk = _c(jax.jit(self._paged_chunk_fn,
+                                        donate_argnums=(1,)))
+            self._pg_mixed = _c(jax.jit(self._paged_mixed_fn,
+                                        donate_argnums=(1,),
+                                        static_argnames=("n",)))
+            self._pg_write_rows = _c(jax.jit(self._paged_write_rows_fn,
+                                             donate_argnums=(0,)))
+            self._pg_gather_rows = _c(jax.jit(self._paged_gather_rows_fn))
+            self._pg_page_copy = _c(jax.jit(self._paged_page_copy_fn,
+                                            donate_argnums=(0,)))
         if draft_model is not None:
             self._draft_chunk = _c(jax.jit(self._draft_chunk_fn,
                                            donate_argnums=(1,)))
@@ -945,6 +1045,362 @@ class InferenceEngine:
             new.append(layer)
         return new
 
+    # --- jitted pieces, paged (serve/paged_kv.py) ----------------------------
+    #
+    # Each program is gather -> UNCHANGED raw engine body -> window
+    # scatter, in ONE jitted dispatch. The host passes precomputed flat
+    # pool-row index arrays (PagedKV.gather_idx / scatter_idx), so the
+    # jitted code is pure take/at — no traced block-table arithmetic,
+    # no retrace (shapes are the only static component: one compile per
+    # pow2 view-width bucket per program, same bound as prefill
+    # buckets). Discarded writes (idle rows, padding past a row's valid
+    # window) are routed by the host indices into the reserved trash
+    # page, which replaces the contiguous path's clamp-and-overwrite
+    # dead-write reasoning wholesale.
+
+    def _paged_view(self, pool, gidx, index_vec):
+        """Gather each slot's pages into a contiguous cache view
+        (slots, W, ...) with the per-slot index pinned from the host."""
+        S, W = gidx.shape
+        flat = gidx.reshape(-1)
+        view = []
+        for layer in pool:
+            d = {"index": index_vec.astype(jnp.int32)}
+            for key, buf in layer.items():
+                d[key] = jnp.take(buf, flat, axis=0).reshape(
+                    (S, W) + buf.shape[1:])
+            view.append(d)
+        return view
+
+    def _paged_writeback(self, pool, view, sidx, wstart):
+        """Scatter each row's freshly written window
+        ``[wstart[s], wstart[s] + Wwin)`` from the view back into the
+        pool at the host-resolved page rows ``sidx``."""
+        S, Wwin = sidx.shape
+        flat = sidx.reshape(-1)
+        j = jnp.arange(Wwin)
+        new = []
+        for pl, vl in zip(pool, view):
+            d = {}
+            for key, buf in pl.items():
+                vb = vl[key]
+                W = vb.shape[1]
+                pos = jnp.clip(wstart[:, None] + j[None, :], 0, W - 1)
+                idx = pos.reshape((S, Wwin) + (1,) * (vb.ndim - 2))
+                rows = jnp.take_along_axis(vb, idx, axis=1)
+                d[key] = buf.at[flat].set(
+                    rows.reshape((S * Wwin,) + vb.shape[2:]).astype(
+                        buf.dtype))
+            new.append(d)
+        return new
+
+    def _paged_decode_fn(self, params, pool, gidx, index_vec, sidx,
+                         tokens, rng, temperature, top_k, top_p, greedy):
+        view = self._paged_view(pool, gidx, index_vec)
+        tok, view = self._decode_fn(params, view, tokens, rng,
+                                    temperature, top_k, top_p, greedy)
+        return tok, self._paged_writeback(pool, view, sidx, index_vec)
+
+    def _paged_multi_fn(self, params, pool, gidx, index_vec, sidx,
+                        tokens, rng, temperature, top_k, top_p, greedy,
+                        *, n):
+        view = self._paged_view(pool, gidx, index_vec)
+        toks, view = decode_scan(self.model, params, view, tokens, rng,
+                                 temperature, top_k, top_p, greedy, n=n)
+        return toks, self._paged_writeback(pool, view, sidx, index_vec)
+
+    def _paged_spec_fn(self, params, pool, gidx, index_vec, sidx, tokens):
+        view = self._paged_view(pool, gidx, index_vec)
+        out, view = self._decode_spec_fn(params, view, tokens)
+        # no device rewind in this layout: the per-dispatch index is
+        # derived from host slot_len, and rejected rows' page contents
+        # are overwritten in place by the next real write
+        return out, self._paged_writeback(pool, view, sidx, index_vec)
+
+    def _paged_chunk_fn(self, params, pool, gidx, chunk_ids, starts,
+                        lens, sidx):
+        view = self._paged_view(pool, gidx, starts)
+        last, view = batched_chunk(self.model, params, view, chunk_ids,
+                                   starts, lens)
+        return last, self._paged_writeback(pool, view, sidx, starts)
+
+    def _paged_mixed_fn(self, params, pool, gidx, chunk_ids, starts,
+                        lens, advance, tokens, rng, temperature, top_k,
+                        top_p, greedy, sidx, *, n):
+        view = self._paged_view(pool, gidx, starts)
+        chunk_last, toks, view = self._mixed_raw(
+            params, view, chunk_ids, starts, lens, advance, tokens,
+            rng, temperature, top_k, top_p, greedy, n=n)
+        return chunk_last, toks, self._paged_writeback(
+            pool, view, sidx, starts)
+
+    def _paged_write_rows_fn(self, pool, rows, sidx):
+        """Scatter B bucket-width row sets (one-shot prefill output, a
+        prefix/handoff entry's rows) into pages; ``rows`` may carry an
+        ``index`` key (pool iteration ignores it)."""
+        S, Wb = sidx.shape
+        flat = sidx.reshape(-1)
+        new = []
+        for pl, rl in zip(pool, rows):
+            d = {}
+            for key, buf in pl.items():
+                rb = rl[key]
+                d[key] = buf.at[flat].set(
+                    rb.reshape((S * Wb,) + rb.shape[2:]).astype(
+                        buf.dtype))
+            new.append(d)
+        return new
+
+    def _paged_gather_rows_fn(self, pool, gidx):
+        """Index-free rows list (1, W, ...) per layer — the page-wise
+        twin of ``_slot_rows_fn`` for prefix/handoff entries."""
+        S, W = gidx.shape
+        flat = gidx.reshape(-1)
+        return [
+            {key: jnp.take(buf, flat, axis=0).reshape(
+                (S, W) + buf.shape[1:])
+             for key, buf in layer.items()}
+            for layer in pool
+        ]
+
+    def _paged_page_copy_fn(self, pool, src, dst):
+        """Copy one physical page's rows (COW fork: a write would land
+        in a page some other reader still maps)."""
+        P = self.paged.page_size
+        new = []
+        for layer in pool:
+            d = {}
+            for key, buf in layer.items():
+                rows = jax.lax.dynamic_slice_in_dim(buf, src * P, P,
+                                                    axis=0)
+                d[key] = jax.lax.dynamic_update_slice_in_dim(
+                    buf, rows, dst * P, axis=0)
+            new.append(d)
+        return new
+
+    # --- paged host-side plumbing -------------------------------------------
+
+    def _paged_width(self, need: int) -> int:
+        """Pow2-bucketed view width covering ``need`` rows (bounded by
+        ``cache_len`` — feasibility gates guarantee ``need`` fits)."""
+        w = self.paged.page_size
+        while w < need:
+            w *= 2
+        w = min(w, self.cache_len)
+        if w < need:
+            raise AssertionError(
+                f"paged view width {w} < needed {need} "
+                f"(cache_len {self.cache_len})")
+        return w
+
+    def _paged_index_vec(self, W: int, wwin: int) -> np.ndarray:
+        """Per-row pinned cache index for a decode-family dispatch:
+        active rows at their true length (the caller sized ``W`` so
+        their writes fit un-clamped), mid-prefill rows at ``done``,
+        free rows at 0 — clamped so even dead in-view writes stay
+        inside the view (their scatter targets are trash anyway)."""
+        idx = np.zeros((self.max_slots,), np.int32)
+        for s in range(self.max_slots):
+            if s in self.slot_prefill:
+                idx[s] = self.slot_prefill[s]["done"]
+            elif self.slot_req[s] is not None:
+                idx[s] = int(self.slot_len[s])
+        return np.minimum(idx, max(W - wwin, 0)).astype(np.int32)
+
+    def _paged_cow_fork(self, slot: int, start: int, width: int) -> None:
+        """Fork any shared page the write window
+        ``[start, start + width)`` would touch. With full-page-only
+        sharing no live path writes inside a shared page (the index
+        caps hits below the last prompt position, suffixes start at the
+        share boundary, and spec rewind never dips below the prompt) —
+        this is the defensive half of the COW contract, kept exact so a
+        future scheduler change degrades to a page copy instead of
+        corrupting a neighbour's prefix."""
+        if width <= 0:
+            return
+        P = self.paged.page_size
+        pool = self.paged.pool
+        bt = self.paged.block_tables
+        for lp in range(start // P,
+                        min((start + width - 1) // P + 1,
+                            self.paged.pages_per_slot)):
+            page = int(bt[slot, lp])
+            if page == 0 or pool.refcount(page) <= 1:
+                continue
+            fresh = pool.try_alloc(1)
+            while fresh is None:
+                # pool dry mid-fork: apply preemption pressure until a
+                # page frees, exactly like the reserve loops — a single
+                # victim whose pages are all still shared frees nothing
+                victim = self._paged_pick_victim(exclude=slot)
+                if victim is None:
+                    raise RuntimeError(
+                        "page pool exhausted during COW fork")
+                self._paged_preempt(victim)
+                fresh = pool.try_alloc(1)
+            self.paged.kv = self._pg_page_copy(
+                self.paged.kv, jnp.asarray(page, jnp.int32),
+                jnp.asarray(fresh[0], jnp.int32))
+            bt[slot, lp] = fresh[0]
+            pool.release([page])
+
+    def _paged_pick_victim(self, exclude: int | None = None) -> int | None:
+        """Preemption policy: the YOUNGEST occupied slot (highest uid)
+        other than ``exclude`` — least work lost, and its re-prefill is
+        mostly a page-index hit since its pages are registered on the
+        way out (vLLM preempts LIFO for the same reason)."""
+        best, best_uid = None, -1
+        for s in range(self.max_slots):
+            if s == exclude or self.slot_req[s] is None:
+                continue
+            uid = self.slot_req[s].uid
+            if uid > best_uid:
+                best, best_uid = s, uid
+        return best
+
+    def _paged_preempt(self, slot: int) -> None:
+        """Preempt ``slot`` by recompute: register its pages in the
+        prefix index (so re-admission is mostly a page hit), release
+        them, and put the request back at the HEAD of the queue —
+        already-emitted tokens ride along via the resume fields, so the
+        client stream continues where it left off."""
+        req = self.slot_req[slot]
+        st = self.slot_prefill.pop(slot, None)
+        if st is None and self.slot_ready[slot]:
+            hist = self.slot_hist[slot]
+            req.resume_last = hist[-1]
+            req.resume_budget = int(self.slot_budget[slot])
+            req.prompt_ids = list(hist[:-1])
+            self._paged_register_pages(hist[:-1], slot)
+        elif st is not None and st["done"] > 0:
+            # mid-prefill: nothing emitted — requeue as a fresh prompt,
+            # but keep the already-computed full pages reusable
+            self._paged_register_pages(req.prompt_ids[:st["done"]], slot)
+        self.paged.release_slot(slot)
+        self.slot_req[slot] = None
+        self.slot_ready[slot] = False
+        self.slot_budget[slot] = 0
+        self.slot_hist[slot] = None
+        if self.draft_model is not None:
+            # force a full draft-cache re-sync if this slot is reused
+            # for this request (its target KV is being recomputed)
+            self._draft_uid[slot] = -1
+        self.preemptions += 1
+        with self.pending.mutex:
+            self.pending.queue.appendleft(req)
+        self._log.info(
+            "preempted slot %d (uid %d) under page-pool pressure; "
+            "request requeued for recompute (resume at %d tokens)",
+            slot, req.uid, len(req.prompt_ids))
+
+    def _paged_reserve_active(self, active: list[int],
+                              width: int) -> list[int]:
+        """Reserve ``width`` more positions for every ready slot before
+        a decode-family dispatch; preempted victims drop out of
+        ``active``, and a slot that cannot grow even as the last
+        occupant finishes with the contiguous layout's ``cache``
+        reason. Returns the surviving active list."""
+        out = list(active)
+        for s in list(out):
+            if s not in out or self.slot_req[s] is None:
+                continue
+            while not self.paged.extend(s, int(self.slot_len[s]) + width):
+                victim = self._paged_pick_victim(exclude=s)
+                if victim is None:
+                    self._finish_slot(s, "cache")
+                    if s in out:
+                        out.remove(s)
+                    break
+                self._paged_preempt(victim)
+                if victim in out:
+                    out.remove(victim)
+        return [s for s in out if self.slot_req[s] is not None
+                and self.slot_ready[s]]
+
+    def _paged_decode_dispatch(self, active: list[int], n: int, sub):
+        """Issue one paged decode dispatch (single-token via the
+        ``_decode_fn`` body at n==1 so the rng use matches the
+        contiguous program exactly; an n-step scan block otherwise).
+        Pages for the writes were reserved by the caller. Returns the
+        sampled tokens, shape (max_slots, n)."""
+        W = self._paged_width(
+            max(int(self.slot_len[s]) for s in active) + n)
+        idxv = self._paged_index_vec(W, n)
+        valid = np.zeros((self.max_slots,), np.int32)
+        for s in active:
+            valid[s] = n
+            self._paged_cow_fork(s, int(self.slot_len[s]), n)
+        gidx = jnp.asarray(self.paged.gather_idx(W))
+        sidx = jnp.asarray(self.paged.scatter_idx(idxv, valid, n))
+        idxv = jnp.asarray(idxv)
+        tokens = jnp.asarray(self.slot_last_token)
+        args = (jnp.asarray(self._temperature),
+                jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                jnp.asarray(self._greedy))
+        if n == 1:
+            tok, self.paged.kv = self._pg_decode(
+                self.params, self.paged.kv, gidx, idxv, sidx, tokens,
+                sub, *args)
+            return tok[:, None]
+        toks, self.paged.kv = self._pg_multi(
+            self.params, self.paged.kv, gidx, idxv, sidx, tokens, sub,
+            *args, n=n)
+        return toks
+
+    def _paged_register_pages(self, token_ids, slot: int) -> None:
+        """Index every FULL page of ``token_ids`` (whose KV fills
+        ``slot``'s first pages) for refcounted sharing."""
+        if self.prefix_cache is None:
+            return
+        nfull = len(token_ids) // self.paged.page_size
+        if nfull <= 0:
+            return
+        pages = self.paged.slot_pages(slot)[:nfull]
+        if len(pages) == nfull:
+            self.prefix_cache.register(
+                list(token_ids[:nfull * self.paged.page_size]), pages)
+
+    def _paged_gather_entry(self, slot: int, plen: int, last_logits):
+        """Page-aligned prefix entry for ``slot``'s first ``plen``
+        positions — rows span ceil(plen/P)*P, not a pow2 bucket nor
+        ``cache_len``, so handoff/offload ship only live pages."""
+        from llm_in_practise_tpu.serve import prefix_cache as pc
+        from llm_in_practise_tpu.serve.paged_kv import pages_for
+
+        width = pages_for(plen, self.paged.page_size) * self.paged.page_size
+        gidx = self.paged.row_gather_idx(slot, width)
+        rows = self._pg_gather_rows(self.paged.kv, jnp.asarray(gidx))
+        return pc.PrefixEntry(length=plen, bucket=width, rows=rows,
+                              last_logits=last_logits, slot_axis=0,
+                              page_size=self.paged.page_size)
+
+    def _paged_insert_entry(self, slot: int, entry, length: int) -> None:
+        """Scatter a row-based entry's first ``length`` positions into
+        ``slot``'s (already reserved) pages. Rows are padded on host to
+        a pow2 bucket so the jitted scatter keeps a bounded compile
+        set whatever widths the tiers shipped."""
+        self._paged_cow_fork(slot, 0, length)
+        Wb = self._bucket_for(length)
+        padded = []
+        for layer in entry.rows:
+            d = {}
+            for key, arr in layer.items():
+                if key == "index":
+                    continue
+                # tier/handoff entries reach a paged engine as HOST
+                # numpy (TieredKV.lookup(device=False), HostEntry), so
+                # this materializes nothing from the device
+                arr = np.asarray(arr)  # graftlint: disable=host-sync
+                out = np.zeros((1, Wb) + arr.shape[2:], arr.dtype)
+                out[:, :min(length, arr.shape[1])] = (
+                    arr[:, :min(length, arr.shape[1])])
+                d[key] = out
+            padded.append(d)
+        sidx = self.paged.rows_scatter_idx([slot], [length], Wb)
+        self.paged.kv = self._pg_write_rows(
+            self.paged.kv, padded, jnp.asarray(sidx))
+
     # --- public API ----------------------------------------------------------
 
     def _shed(self, req: Request) -> Request:
@@ -975,6 +1431,20 @@ class InferenceEngine:
             prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
         req = Request(next(self._uid), prompt_ids, params, engine=self,
                       handoff_id=handoff_id, trace=trace)
+        if (self.paged is not None
+                and not self.paged.fits_ever(len(prompt_ids) + 1)):
+            # the prompt can NEVER fit the page pool (prompt pages + the
+            # first decode page exceed capacity even on an empty pool) —
+            # fail synchronously with a reason the API layer maps to a
+            # 422, instead of letting the request age out of the queue
+            # as a generic queue_full after queue_timeout_s
+            self.rejected_too_large += 1
+            with self.stats.lock:
+                self.stats.requests_total += 1
+            req.finish_time = time.monotonic()
+            req.finish_reason = "too_large"
+            req.tokens.put(_FINISH)
+            return req
         # the upload must land on the request BEFORE it is queued — the
         # engine thread may admit it the instant the put releases
         if kv_entry is not None:
@@ -1035,6 +1505,7 @@ class InferenceEngine:
         (no prefix hit, no chunking) are collected and run as BATCHED
         dispatches; prefix hits and chunked prompts take their own paths."""
         admitted = False
+        self._paged_admit_blocked = False
         # snapshot the knob: it is the blessed runtime attribute (the
         # serve bench flips it post-warmup from another thread) and a
         # mid-step disable to None must not turn a passed `is not None`
@@ -1051,7 +1522,11 @@ class InferenceEngine:
                     head = (self.pending.queue[0]
                             if self.pending.queue else None)
                     if (head is None
+                            or head.resume_last is not None
                             or now - head.submit_time <= timeout_s):
+                        # preempted-resume requests are exempt: their
+                        # stream already started, so a deadline shed
+                        # would truncate a live response
                         break
                     self.pending.queue.popleft()
                 self._shed(head)
@@ -1061,6 +1536,11 @@ class InferenceEngine:
         for slot in range(self.max_slots):
             if self.slot_req[slot] is not None:
                 continue
+            if self._paged_admit_blocked:
+                # the page pool could not cover the previous admission
+                # this step — later queue entries would fail the same
+                # reservation (and double-count admission telemetry)
+                break
             req = None
             while req is None:
                 try:
@@ -1068,6 +1548,7 @@ class InferenceEngine:
                 except queue.Empty:
                     break
                 if (timeout_s is not None
+                        and req.resume_last is None
                         and time.monotonic() - req.submit_time
                         > timeout_s):
                     # waited past the deadline: the client is better
@@ -1150,6 +1631,30 @@ class InferenceEngine:
         power-of-two sub-batches (compiled variants bounded at
         log2(max_slots) per bucket), sample every first token in ONE
         batched call."""
+        if self.paged is not None:
+            # page-granular admission: reserve ACTUAL prompt pages (+1
+            # decode token) per member; a dry pool requeues the member
+            # and blocks further admission this step
+            kept, blocked = [], []
+            for slot, req, plen in batch:
+                if (not self._paged_admit_blocked
+                        and self.paged.extend(slot, plen + 1)):
+                    kept.append((slot, req, plen))
+                else:
+                    self.slot_req[slot] = None
+                    self.slot_ready[slot] = False
+                    self._paged_admit_blocked = True
+                    blocked.append(req)
+            # requeue in REVERSE so the oldest blocked member lands at
+            # the queue head (appendleft in forward order would invert
+            # FIFO — and the timeout-shed loop assumes head-monotone
+            # staleness)
+            with self.pending.mutex:
+                for req in reversed(blocked):
+                    self.pending.queue.appendleft(req)
+            batch = kept
+            if not batch:
+                return
         by_bucket: dict[int, list[tuple[int, Request, int]]] = {}
         for slot, req, plen in batch:
             by_bucket.setdefault(self._bucket_for(plen), []).append(
@@ -1168,10 +1673,17 @@ class InferenceEngine:
                 t0 = time.monotonic()
                 last, pre = self._prefill(
                     self.params, jnp.asarray(ids), jnp.asarray(lens))
-                slot_ids = np.array([p[0] for p in part], np.int32)
-                self.cache = self._insert_batch(
-                    self.cache, pre, jnp.asarray(slot_ids),
-                    jnp.asarray(lens))
+                if self.paged is not None:
+                    sidx = self.paged.rows_scatter_idx(
+                        [p[0] for p in part], [p[2] for p in part],
+                        bucket)
+                    self.paged.kv = self._pg_write_rows(
+                        self.paged.kv, pre, jnp.asarray(sidx))
+                else:
+                    slot_ids = np.array([p[0] for p in part], np.int32)
+                    self.cache = self._insert_batch(
+                        self.cache, pre, jnp.asarray(slot_ids),
+                        jnp.asarray(lens))
                 self.rng, sub = jax.random.split(self.rng)
                 first = np.asarray(sample_token_batched(
                     sub, last.astype(jnp.float32),
@@ -1197,11 +1709,19 @@ class InferenceEngine:
                     weight_passes=1, kv_read_tokens=keys,
                     dt=time.monotonic() - t0)
                 for j, (slot, req, plen) in enumerate(part):
-                    sl = (slice(None),) * self._sax + (slice(j, j + 1),)
-                    row_slices = [{k: v[sl] for k, v in layer.items()
-                                   if k != "index"} for layer in pre]
-                    self._store_prefix(req, plen, row_slices,
-                                       last[j:j + 1])
+                    if self.paged is not None:
+                        # rows are in pages now — register them instead
+                        # of slicing copies (handoff gathers page-wise)
+                        row_slices = None
+                        self._paged_store_prefix(req, plen, slot,
+                                                 last[j:j + 1])
+                    else:
+                        sl = ((slice(None),) * self._sax
+                              + (slice(j, j + 1),))
+                        row_slices = [{k: v[sl] for k, v in layer.items()
+                                       if k != "index"} for layer in pre]
+                        self._store_prefix(req, plen, row_slices,
+                                           last[j:j + 1])
                     if req.handoff_id is not None:
                         # the group's bucket IS _bucket_for(plen), so
                         # these rows are already handoff-width — skip
@@ -1229,15 +1749,26 @@ class InferenceEngine:
         index-free row dicts already sliced from the prefill cache."""
         from llm_in_practise_tpu.serve import prefix_cache as pc
 
-        bucket = self._bucket_for(plen)
-        if rows is None:
-            rows = self._slot_rows(self.cache, jnp.asarray(slot, jnp.int32),
-                                   bucket=bucket)
-        # _slot_rows / the batch slices COPY the rows into fresh buffers,
-        # so the entry is independent of the slot, which frees right here
-        entry = pc.PrefixEntry(length=plen, bucket=bucket, rows=rows,
-                               last_logits=last_logits,
-                               slot_axis=self._sax)
+        if self.paged is not None:
+            # page-wise handoff: the entry spans ceil(plen/P)*P rows —
+            # only live pages ship over the wire, not a pow2 bucket (a
+            # 200-token prompt is 13 16-row pages = 208 rows, where the
+            # bucket path shipped 256). The gather COPIES the page rows
+            # into fresh buffers, so the slot's pages free right here.
+            entry = self._paged_gather_entry(slot, plen, last_logits)
+            self.paged.release_slot(slot)
+        else:
+            bucket = self._bucket_for(plen)
+            if rows is None:
+                rows = self._slot_rows(self.cache,
+                                       jnp.asarray(slot, jnp.int32),
+                                       bucket=bucket)
+            # _slot_rows / the batch slices COPY the rows into fresh
+            # buffers, so the entry is independent of the slot, which
+            # frees right here
+            entry = pc.PrefixEntry(length=plen, bucket=bucket, rows=rows,
+                                   last_logits=last_logits,
+                                   slot_axis=self._sax)
         self.slot_req[slot] = None
         self.slot_ready[slot] = False
         self.slot_budget[slot] = 0
@@ -1301,6 +1832,11 @@ class InferenceEngine:
         if req.handoff_id is not None:
             return self._complete_handoff(slot, req, plen, last_logits,
                                           rows=rows)
+        if req.resume_last is not None:
+            # preemption resume: the "next" token was already emitted
+            # before the preempt — no sampling, no rng split (the
+            # stream must not fork from what the client saw)
+            return self._activate_with_token(slot, req, plen, 0)
         self.rng, sub = jax.random.split(self.rng)
         first = sample_token_batched(
             sub, last_logits.astype(jnp.float32),
@@ -1313,18 +1849,29 @@ class InferenceEngine:
 
     def _activate_with_token(self, slot: int, req: Request, plen: int,
                              first_id: int):
-        req.first_token_time = time.monotonic()
+        resumed = req.resume_last is not None
+        if resumed:
+            # preemption resume (paged layout): the prompt now IS the
+            # full emitted history minus the resume token, whose KV is
+            # the next decode's to write. Nothing is emitted here and
+            # the TTFT stamp is the original one.
+            first_id = req.resume_last
+            req.resume_last = None
+        else:
+            req.first_token_time = time.monotonic()
         self.slot_req[slot] = req
         self.slot_ready[slot] = True
         self.slot_last_token[slot] = first_id
         self.slot_len[slot] = plen
-        self.slot_budget[slot] = req.params.max_tokens - 1
+        self.slot_budget[slot] = (req.resume_budget if resumed
+                                  else req.params.max_tokens - 1)
         self._temperature[slot] = req.params.temperature
         self._top_k[slot] = req.params.top_k
         self._top_p[slot] = req.params.top_p
         self._greedy[slot] = req.params.greedy
         self.slot_hist[slot] = list(req.prompt_ids) + [first_id]
-        self._emit(slot, first_id)
+        if not resumed:
+            self._emit(slot, first_id)
 
     def _chunk_span(self, rem: int) -> int:
         """Padded length the chunked path would write for ``rem`` tokens."""
@@ -1360,9 +1907,16 @@ class InferenceEngine:
             self.kv_rejected += 1
             self._log.warning("rejecting handed-off KV entry: %s", why)
             return None
+        if self.paged is not None:
+            # keep the entry HOST-side: paged admission scatters it
+            # page-by-page into the slot's reserved pages (no whole-
+            # entry device buffer ever exists)
+            return host
         return entry_to_device(host)
 
     def _lookup_prefix(self, req: Request, plen: int):
+        if self.paged is not None:
+            return self._paged_lookup(req, plen)
         ext = req.kv_entry
         if ext is not None:
             # handed-off KV (disaggregated serving): already validated
@@ -1380,8 +1934,12 @@ class InferenceEngine:
                 return False
             # rows from another engine (shared pool) may be padded to a
             # bucket this engine's cache can't hold — the insert/suffix
-            # scatters would clamp and corrupt the slot
-            if entry.bucket > self.cache_len:
+            # scatters would clamp and corrupt the slot. Page-aligned
+            # widths are judged POST-pow2-padding (entry_to_device pads
+            # them so the jitted insert keeps a bounded compile set).
+            from llm_in_practise_tpu.serve.kv_pool import effective_bucket
+
+            if effective_bucket(entry) > self.cache_len:
                 return False
             # every padded write the remaining prefill would do must land
             # inside cache_len, or the scatter clamps and corrupts the
@@ -1408,6 +1966,170 @@ class InferenceEngine:
         self.prefix_cache.put(req.prompt_ids[: hit.length], hit)
         return hit
 
+    def _paged_lookup(self, req: Request, plen: int):
+        """Paged admission's prefix resolution, best hit first:
+
+        1. a claimed handoff entry (full-length host rows, validated at
+           submit) — the disagg direct-insert path;
+        2. the page index — partial-prefix hits at PAGE granularity,
+           zero copies: the matched physical pages are refcounted into
+           this slot's block table (the all-or-nothing direct-insert
+           limitation this layout removes);
+        3. the kv-pool tiers (host/remote row entries), fetched
+           host-side and page-scattered at admission; their pages are
+           then registered so the NEXT request hits tier 2.
+        """
+        from llm_in_practise_tpu.serve.paged_kv import PagedHit
+
+        ext = req.kv_entry
+        if ext is not None:
+            req.kv_entry = None
+            self.kv_admitted += 1
+            return PagedHit(length=ext.length, entry=ext,
+                            last_logits=ext.last_logits, external=True)
+        if self.prefix_cache is None:
+            return None
+        pages = self.prefix_cache.lookup(req.prompt_ids)
+        if pages:
+            return PagedHit(length=len(pages) * self.paged.page_size,
+                            pages=pages)
+        if self.kv_pool is None:
+            return None
+
+        def usable(entry) -> bool:
+            # layout must match (slot axis 0), and every padded write
+            # the remaining suffix prefill would do must land inside
+            # cache_len — the paged one-shot suffix runs a
+            # bucket_for(rem)-wide chunk at `done`, so the fit law is
+            # the SAME as the contiguous filter (only the entry-bucket
+            # cap is dropped: the page scatter writes positions, not
+            # padded buckets)
+            if getattr(entry, "slot_axis", 0) != 0:
+                return False
+            if entry.length >= plen:
+                return entry.length == plen
+            rem = plen - entry.length
+            return (self._oneshot_fits(entry.length, rem)
+                    or self._chunked_fits(entry.length, rem))
+
+        from llm_in_practise_tpu.serve.kv_pool import TieredKV
+
+        if isinstance(self.kv_pool, TieredKV):
+            # host-side entries: the rows are page-scattered at
+            # admission, so a whole-entry device upload would be waste
+            host = self.kv_pool.lookup(req.prompt_ids, usable=usable,
+                                       device=False)
+        else:
+            # bare pools (HostKVPool etc.) have no device kwarg and
+            # already return host entries
+            host = self.kv_pool.lookup(req.prompt_ids, usable=usable)
+        if host is None:
+            return None
+        return PagedHit(
+            length=host.length, entry=host,
+            last_logits=host.last_logits if host.length == plen else None)
+
+    def _paged_begin_prefill(self, req: Request, slot: int, plen: int,
+                             hit) -> None:
+        """Paged admission for one request: reserve ACTUAL pages
+        (prompt + first decode token — not a cache_len worst case), map
+        or scatter whatever prefix the lookup found, then chunk or
+        one-shot the suffix. A dry pool requeues the request and blocks
+        further admission this step (decode-side growth may preempt;
+        admission never does)."""
+        P = self.paged.page_size
+        if hit is not None and hit.pages is not None:
+            # a page hit whose suffix neither chunks nor fits a one-shot
+            # bucket inside cache_len shrinks page by page first (the
+            # paged analog of the contiguous usable() fit filter)
+            done, rem = hit.length, plen - hit.length
+            while (done > 0 and not self._should_chunk(done, rem)
+                   and done + self._bucket_for(rem) > self.cache_len):
+                done -= P
+                rem += P
+            if done < hit.length:
+                self.paged.pool.release(hit.pages[done // P:])
+                hit = (dataclasses.replace(hit, length=done,
+                                           pages=hit.pages[:done // P])
+                       if done > 0 else None)
+            if hit is not None:
+                self.paged.map_shared(slot, hit.pages)
+        if not self.paged.extend(slot, plen + 1):
+            # not admissible right now: hand the shared refs back, put
+            # the request at the queue head, stop admitting this step
+            # (decode-side growth may preempt; admission never does)
+            self.paged.release_slot(slot)
+            self.slot_req[slot] = None
+            if hit is not None and hit.entry is not None and hit.external:
+                # a handoff claim is consume-once: stash it back on the
+                # request (and un-count the consumption) or the retry
+                # pays a full local prefill for an entry we still hold
+                req.kv_entry = hit.entry
+                self.kv_admitted -= 1
+            self._paged_admit_blocked = True
+            with self.pending.mutex:
+                self.pending.queue.appendleft(req)
+            return
+        done = hit.length if hit is not None else 0
+        if hit is not None and hit.entry is not None:
+            self._paged_insert_entry(slot, hit.entry, hit.length)
+            # promote the tier hit into the page index: the next
+            # request with this prefix shares pages instead of
+            # re-fetching rows
+            self._paged_register_pages(req.prompt_ids[:hit.length], slot)
+            if hit.length == plen:
+                self._activate(slot, req, plen, hit.last_logits)
+                return
+        rem = plen - done
+        if self._should_chunk(done, rem):
+            self.slot_req[slot] = req
+            self.slot_ready[slot] = False
+            self.slot_prefill[slot] = {"req": req, "plen": plen,
+                                       "done": done, "last_logits": None}
+            return
+        last_logits = self._paged_suffix(slot, req.prompt_ids[done:],
+                                         done)
+        # store the finished prompt like every other completion path:
+        # register its pages for sharing + tier write-through (the
+        # contiguous twin does this in _finish_prefill)
+        self._paged_store_prefix(req, plen, slot, last_logits)
+        self._activate(slot, req, plen, last_logits)
+
+    def _paged_suffix(self, slot: int, suffix, done: int):
+        """One-shot prefill of ``suffix`` into ``slot`` at ``done``
+        through the paged chunk program (the dedicated contiguous
+        ``_prefill_suffix`` program has no paged twin — the chunk body
+        is the same pinned-index math). Returns the last-position
+        logits row."""
+        C = self._bucket_for(len(suffix))
+        tok = np.zeros((self.max_slots, C), np.int32)
+        tok[slot, :len(suffix)] = suffix
+        W = self._paged_width(done + C)
+        starts = self._paged_index_vec(W, C)
+        starts[slot] = done
+        lens = np.zeros((self.max_slots,), np.int32)
+        lens[slot] = len(suffix)
+        valid = np.zeros((self.max_slots,), np.int32)
+        valid[slot] = len(suffix)
+        self._paged_cow_fork(slot, done, len(suffix))
+        sidx = self.paged.scatter_idx(starts, valid, C)
+        gidx = self.paged.gather_idx(W)
+        t0 = time.monotonic()
+        last, self.paged.kv = self._pg_chunk(
+            self.params, self.paged.kv, jnp.asarray(gidx),
+            jnp.asarray(tok), jnp.asarray(starts), jnp.asarray(lens),
+            jnp.asarray(sidx))
+        out = last[slot:slot + 1]
+        # force + stamp dt exactly like _prefill_into_slot (the logits
+        # feed the first-token sample on this same call path anyway)
+        jax.block_until_ready(out)
+        keys = CostModel.chunk_keys(len(suffix), done)
+        self._note_device_phase(
+            "prefill", tokens=len(suffix), attended_keys=keys,
+            weight_passes=1, kv_read_tokens=keys,
+            dt=time.monotonic() - t0)
+        return out
+
     _UNSET = object()
 
     def _begin_prefill(self, req: Request, slot: int, plen: int,
@@ -1416,6 +2138,10 @@ class InferenceEngine:
         long remainder (chunked prefill on) → incremental, one chunk per
         engine step so running slots keep decoding; otherwise one-shot.
         ``hit`` may be passed by ``_admit`` (which already looked it up)."""
+        if self.paged is not None:
+            if hit is self._UNSET:
+                hit = self._lookup_prefix(req, plen)
+            return self._paged_begin_prefill(req, slot, plen, hit)
         if hit is self._UNSET:
             hit = self._lookup_prefix(req, plen)
         if hit is not None and hit.length == plen:
@@ -1461,6 +2187,11 @@ class InferenceEngine:
         ~chunks/budget steps)."""
         progressed = False
         while budget > 0 and self.slot_prefill:
+            # paged layout: no per-chunk page reservation is needed —
+            # admission reserved the WHOLE prompt's pages (+1 decode
+            # token) before the slot entered slot_prefill, so every
+            # chunk write is already covered; only decode GROWTH
+            # allocates on demand (_paged_reserve_active)
             entries = []
             for slot in sorted(self.slot_prefill):
                 st = self.slot_prefill[slot]
@@ -1471,20 +2202,26 @@ class InferenceEngine:
             # whole-cache batching needs every row's C-wide write window
             # inside cache_len — a clamped scatter on a near-full ACTIVE
             # row would overwrite attended KV. Rare tail case: fall back
-            # to sequential single-slot chunks.
-            batchable = len(entries) > 1 and all(
-                int(self.slot_len[s]) + C <= self.cache_len
-                for s in range(self.max_slots)
-                if s not in self.slot_prefill
-                and self.slot_req[s] is not None  # free rows are dead
-            )
+            # to sequential single-slot chunks. (The paged layout is
+            # always batchable: discarded writes are routed to the
+            # trash page by the host-built scatter indices, so there is
+            # no clamp hazard to dodge.)
+            batchable = self.paged is not None or (
+                len(entries) > 1 and all(
+                    int(self.slot_len[s]) + C <= self.cache_len
+                    for s in range(self.max_slots)
+                    if s not in self.slot_prefill
+                    and self.slot_req[s] is not None  # free rows are dead
+                ))
             # device-plane accounting reads each chunk's pre-advance
             # context; compute before the branches mutate st["done"]
             pf_tokens = sum(len(c) for _, _, c in entries)
             pf_keys = sum(CostModel.chunk_keys(len(c), st["done"])
                           for _, st, c in entries)
             t0 = time.monotonic()
-            if batchable:
+            if self.paged is not None:
+                self._paged_chunk_dispatch(entries)
+            elif batchable:
                 tok, starts, lens = self._chunk_batch_rows(entries)
                 last, self.cache = self._chunk_batch(
                     self.params, self.cache, jnp.asarray(tok),
@@ -1557,6 +2294,35 @@ class InferenceEngine:
             lens[slot] = len(chunk)
         return tok, starts, lens
 
+    def _paged_chunk_dispatch(self, entries) -> None:
+        """Advance every mid-prefill row one chunk against the PAGE
+        POOL in a single dispatch: gather a bucketed contiguous view,
+        run the shared ``batched_chunk`` body, scatter each prefill
+        row's real chunk window back to its pages (everything else —
+        idle rows' dead windows, padding — lands in the trash page)."""
+        C = self.chunked_prefill
+        tok, starts, lens = self._chunk_batch_rows(entries)
+        W = self._paged_width(
+            max(st["done"] for _, st, _ in entries) + C)
+        # non-prefill rows' dead C-wide in-view writes must stay inside
+        # the view; their view copy is discarded (windows are trash),
+        # so the clamp is harmless — prefill rows stay exact
+        starts = np.minimum(starts, W - C)
+        valid = np.zeros((self.max_slots,), np.int32)
+        for slot, st, chunk in entries:
+            starts[slot] = st["done"]
+            valid[slot] = len(chunk)
+            self._paged_cow_fork(slot, st["done"], len(chunk))
+        sidx = self.paged.scatter_idx(starts, valid, C)
+        gidx = self.paged.gather_idx(W)
+        last, self.paged.kv = self._pg_chunk(
+            self.params, self.paged.kv, jnp.asarray(gidx),
+            jnp.asarray(tok), jnp.asarray(starts), jnp.asarray(lens),
+            jnp.asarray(sidx))
+        for slot, st, chunk in entries:
+            st["last_logits"] = last[slot:slot + 1]
+            st["done"] += len(chunk)
+
     def _finalize_prefills(self) -> None:
         """Activate every chunked prefill whose prompt is fully fed —
         shared tail of the sequential and fused mixed-step paths."""
@@ -1569,7 +2335,10 @@ class InferenceEngine:
             # rows are already in the slot; store the prefix entry
             # from them (the index is plen — set by the final chunk)
             rows = None
-            if self.prefix_cache is not None:
+            if self.paged is not None:
+                self._paged_store_prefix(req, plen, slot,
+                                         st["last_logits"])
+            elif self.prefix_cache is not None:
                 rows = self._slot_rows(
                     self.cache, jnp.asarray(slot, jnp.int32),
                     bucket=self._bucket_for(plen))
@@ -1579,6 +2348,22 @@ class InferenceEngine:
             # the gathered rows ride through to the handoff path so a
             # chunked handoff doesn't pay the gather dispatch twice
             self._activate(slot, req, plen, st["last_logits"], rows=rows)
+
+    def _paged_store_prefix(self, req: Request, plen: int, slot: int,
+                            last_logits) -> None:
+        """Paged twin of ``_store_prefix``: the prompt's KV is already
+        in ``slot``'s pages, so "storing" the prefix is registering the
+        full pages in the sharing index (zero copies) plus the optional
+        kv-pool write-through of a page-aligned row entry. Write-through
+        is duck-typed: a lookup-only pool (bare HostKVPool) simply gets
+        no copies."""
+        if self.prefix_cache is not None:
+            self._paged_register_pages(req.prompt_ids[:plen], slot)
+        if (self.kv_pool is not None
+                and getattr(self.kv_pool, "offload_on_put", False)):
+            self.kv_pool.offload(
+                req.prompt_ids[:plen],
+                self._paged_gather_entry(slot, plen, last_logits))
 
     def _store_prefix(self, req: Request, plen: int, pre_cache,
                       last_logits, *, rows_ready: bool = False) -> None:
@@ -1652,6 +2437,37 @@ class InferenceEngine:
         self._finish_prefill(req, slot, plen, pre_cache, last_logits)
         return last_logits
 
+    def _finish_slot(self, slot: int, reason: str) -> None:
+        """Finish ``slot``'s request with ``reason`` and free the slot —
+        the single exit for decode completions (eos/length/cache) and
+        the paged pool's last-occupant exhaustion. In the paged layout
+        the slot's full pages are registered for sharing on the way out
+        (a follow-up turn reuses the whole conversation's KV) and the
+        block table releases its references — the churn test pins that
+        this leaks nothing."""
+        req = self.slot_req[slot]
+        req.finish_time = time.monotonic()
+        req.finish_reason = reason
+        if req.first_token_time is not None:
+            # the decode phase: first token → finish (TPOT × tokens).
+            # Recorded BEFORE _FINISH is released: a consumer that
+            # saw the stream end must find the span in the ring.
+            self._trace_phase(
+                req, "engine.decode",
+                req.finish_time - req.first_token_time,
+                slot=slot, tokens=req.n_generated,
+                finish_reason=req.finish_reason)
+        if self.paged is not None:
+            hist = self.slot_hist[slot]
+            if hist:
+                self._paged_register_pages(hist[:-1], slot)
+            self.paged.release_slot(slot)
+        req.tokens.put(_FINISH)
+        self.stats.observe_finished(req)
+        self.slot_req[slot] = None
+        self.slot_ready[slot] = False
+        self.slot_budget[slot] = 0
+
     def _emit(self, slot: int, token_id: int):
         req = self.slot_req[slot]
         budget_left = self.slot_budget[slot] > 0
@@ -1662,24 +2478,8 @@ class InferenceEngine:
             req.tokens.put(token_id)
             req.n_generated += 1
         if hit_eos or not budget_left or not room:
-            req.finish_time = time.monotonic()
-            req.finish_reason = (
-                "stop" if hit_eos else ("length" if not budget_left else "cache")
-            )
-            if req.first_token_time is not None:
-                # the decode phase: first token → finish (TPOT × tokens).
-                # Recorded BEFORE _FINISH is released: a consumer that
-                # saw the stream end must find the span in the ring.
-                self._trace_phase(
-                    req, "engine.decode",
-                    req.finish_time - req.first_token_time,
-                    slot=slot, tokens=req.n_generated,
-                    finish_reason=req.finish_reason)
-            req.tokens.put(_FINISH)
-            self.stats.observe_finished(req)
-            self.slot_req[slot] = None
-            self.slot_ready[slot] = False
-            self.slot_budget[slot] = 0
+            self._finish_slot(slot, "stop" if hit_eos else
+                              ("length" if not budget_left else "cache"))
 
     def _draft(self, hist: list[int], k: int) -> list[int] | None:
         """Prompt-lookup draft: find the most recent earlier occurrence of
@@ -1733,6 +2533,14 @@ class InferenceEngine:
         k = self.speculative_k
         if not self._spec_applicable(active):
             return False
+        if self.paged is not None:
+            # the k+1-wide verify writes k+1 rows per slot: reserve the
+            # pages up front (preempting youngest slots if dry) — the
+            # speculative watermark of any preempted slot is reset in
+            # _paged_preempt, so a recycled draft cache re-syncs
+            active = self._paged_reserve_active(active, k + 1)
+            if not active:
+                return True
         if self.draft_model is not None:
             drafts = self._draft_model_propose(active, k)
         else:
@@ -1748,8 +2556,23 @@ class InferenceEngine:
         for s, d in drafts.items():
             tokens[s, 1: 1 + len(d)] = d
         t0 = time.monotonic()
-        out, self.cache = self._decode_spec(
-            self.params, self.cache, jnp.asarray(tokens))
+        if self.paged is not None:
+            W = self._paged_width(
+                max(int(self.slot_len[s]) for s in active) + k + 1)
+            idxv = self._paged_index_vec(W, k + 1)
+            valid = np.zeros((self.max_slots,), np.int32)
+            for s in active:
+                valid[s] = k + 1
+                self._paged_cow_fork(s, int(self.slot_len[s]), k + 1)
+            out, self.paged.kv = self._pg_spec(
+                self.params, self.paged.kv,
+                jnp.asarray(self.paged.gather_idx(W)),
+                jnp.asarray(idxv),
+                jnp.asarray(self.paged.scatter_idx(idxv, valid, k + 1)),
+                jnp.asarray(tokens))
+        else:
+            out, self.cache = self._decode_spec(
+                self.params, self.cache, jnp.asarray(tokens))
         out_host = np.asarray(out)
         # the verify is ONE wide forward over k+1 positions per slot
         # (that width amortizing the weight read is the whole spec bet
@@ -1779,7 +2602,11 @@ class InferenceEngine:
                 if self.slot_req[s] is None:
                     break                     # finished mid-burst (eos/len)
                 self._commit_token(s, int(out_host[s, j]))
-        self.cache = self._rewind(self.cache, jnp.asarray(delta))
+        if self.paged is None:
+            # paged needs no rewind: the index is pinned from host
+            # slot_len each dispatch, and rejected rows' page contents
+            # are overwritten in place by the next real write
+            self.cache = self._rewind(self.cache, jnp.asarray(delta))
         return True
 
     def _commit_token(self, slot: int, tok: int) -> None:
@@ -1867,13 +2694,25 @@ class InferenceEngine:
                     f"> cache_len {self.cache_len}")
         return True, ""
 
-    def _mixed_dispatch(self, active: list[int], n: int) -> None:
+    def _mixed_dispatch(self, active: list[int], n: int) -> bool:
         """Issue the fused mixed-batch program: every mid-prefill row
         advances one chunk AND every ready row decodes an ``n``-block,
         in ONE device dispatch (serve/mixed_step.py). Host bookkeeping
         mirrors the sequential paths exactly: chunk results feed
-        ``slot_prefill``/finalization, block tokens commit per slot."""
+        ``slot_prefill``/finalization, block tokens commit per slot.
+        Returns False (nothing dispatched) only when paged page
+        reservation drained either half — the caller falls through to
+        the sequential paths for this step."""
         C = self.chunked_prefill
+        if self.paged is not None:
+            # reserve the decode half's writes: n rows per ready slot
+            # (may preempt youngest). The prefill half needs nothing —
+            # admission reserved every prompt page up front, and the
+            # scan's garbage rows above each prefill watermark scatter
+            # to the trash page.
+            active = self._paged_reserve_active(active, n)
+            if not active or not self.slot_prefill:
+                return False
         entries = []
         for slot in sorted(self.slot_prefill):
             st = self.slot_prefill[slot]
@@ -1895,16 +2734,52 @@ class InferenceEngine:
                       for s in active)
         t0 = time.monotonic()
         self.rng, sub = jax.random.split(self.rng)
-        chunk_last, toks, self.cache = self._mixed(
-            self.params, self.cache, jnp.asarray(tok),
-            jnp.asarray(starts), jnp.asarray(lens), jnp.asarray(advance),
-            jnp.asarray(self.slot_last_token), sub,
-            jnp.asarray(self._temperature),
-            jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p),
-            jnp.asarray(self._greedy),
-            n=n,
-        )
+        if self.paged is not None:
+            # view must hold: each prefill row's chunk + the scan's n
+            # garbage rows above it (done+C+n), and each occupied
+            # decode row's dead chunk window (len+C; the scan's real n
+            # rows overwrite its head) — the same extents
+            # _mixed_feasible bounds against cache_len
+            need = max(
+                [st["done"] + C + n for _, st, _ in entries]
+                + [int(self.slot_len[s]) + C for s in range(self.max_slots)
+                   if s not in self.slot_prefill
+                   and self.slot_req[s] is not None] + [C + n])
+            W = self._paged_width(need)
+            starts = np.minimum(starts, W - C)
+            valid = np.zeros((self.max_slots,), np.int32)
+            for slot, st, chunk in entries:
+                starts[slot] = st["done"]
+                valid[slot] = len(chunk)
+                self._paged_cow_fork(slot, st["done"], len(chunk))
+            for s in active:
+                valid[s] = n
+                self._paged_cow_fork(s, int(self.slot_len[s]), n)
+            chunk_last, toks, self.paged.kv = self._pg_mixed(
+                self.params, self.paged.kv,
+                jnp.asarray(self.paged.gather_idx(W)),
+                jnp.asarray(tok), jnp.asarray(starts),
+                jnp.asarray(lens), jnp.asarray(advance),
+                jnp.asarray(self.slot_last_token), sub,
+                jnp.asarray(self._temperature),
+                jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                jnp.asarray(self._greedy),
+                jnp.asarray(self.paged.scatter_idx(starts, valid, C)),
+                n=n,
+            )
+        else:
+            chunk_last, toks, self.cache = self._mixed(
+                self.params, self.cache, jnp.asarray(tok),
+                jnp.asarray(starts), jnp.asarray(lens),
+                jnp.asarray(advance),
+                jnp.asarray(self.slot_last_token), sub,
+                jnp.asarray(self._temperature),
+                jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                jnp.asarray(self._greedy),
+                n=n,
+            )
         toks_host = np.asarray(toks)  # forces the dispatch's results
         dt = time.monotonic() - t0
         self.mixed_blocks += 1
@@ -1927,6 +2802,7 @@ class InferenceEngine:
             weight_passes=n, kv_read_tokens=dc_keys, dt=dt * (1 - share))
         self._finalize_prefills()
         self._commit_block(active, toks_host, n)
+        return True
 
     def _commit_block(self, active: list[int], toks_host, n: int) -> None:
         """Book an ``n``-step decode block's tokens ((B, n) host array)
@@ -2018,18 +2894,23 @@ class InferenceEngine:
                             "outputs are unchanged — spec is lossless); "
                             "speculation resumes when no prefill is in "
                             "flight")
-                    self._mixed_dispatch(active, n)
-                    self._update_active_stats()
-                    return True
-                # log each fallback KIND once (the detail after ':'
-                # varies per occurrence; keying the dedup on it would
-                # grow without bound on a long-running server)
-                kind = why.split(":", 1)[0]
-                if kind not in self._mixed_fallbacks_logged:
-                    self._mixed_fallbacks_logged.add(kind)
-                    self._log.info(
-                        "fused mixed step fell back to sequential "
-                        "dispatches: %s", why)
+                    if self._mixed_dispatch(active, n):
+                        self._update_active_stats()
+                        return True
+                    # paged page reservation drained one half of the
+                    # mixed sets: run this step's remainder on the
+                    # sequential paths
+                    active = self._ready_slots()
+                else:
+                    # log each fallback KIND once (the detail after ':'
+                    # varies per occurrence; keying the dedup on it
+                    # would grow without bound on a long-running server)
+                    kind = why.split(":", 1)[0]
+                    if kind not in self._mixed_fallbacks_logged:
+                        self._mixed_fallbacks_logged.add(kind)
+                        self._log.info(
+                            "fused mixed step fell back to sequential "
+                            "dispatches: %s", why)
         progressed = self._advance_prefills(budget) or pre_progress
         active = self._ready_slots()
         if not active:
@@ -2048,16 +2929,22 @@ class InferenceEngine:
         )
         if use_multi:
             t0 = time.monotonic()
-            toks, self.cache = self._decode_multi(
-                self.params, self.cache,
-                jnp.asarray(self.slot_last_token),
-                sub,
-                jnp.asarray(self._temperature),
-                jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p),
-                jnp.asarray(self._greedy),
-                n=n,
-            )
+            if self.paged is not None:
+                active = self._paged_reserve_active(active, n)
+                if not active:
+                    return True  # reservation finished/preempted them all
+                toks = self._paged_decode_dispatch(active, n, sub)
+            else:
+                toks, self.cache = self._decode_multi(
+                    self.params, self.cache,
+                    jnp.asarray(self.slot_last_token),
+                    sub,
+                    jnp.asarray(self._temperature),
+                    jnp.asarray(self._top_k),
+                    jnp.asarray(self._top_p),
+                    jnp.asarray(self._greedy),
+                    n=n,
+                )
             toks_host = np.asarray(toks)
             keys = sum(CostModel.block_keys(n, int(self.slot_len[s]))
                        for s in active)
@@ -2069,15 +2956,22 @@ class InferenceEngine:
             self._update_active_stats()
             return True
         t0 = time.monotonic()
-        next_tok, self.cache = self._decode(
-            self.params, self.cache,
-            jnp.asarray(self.slot_last_token),
-            sub,
-            jnp.asarray(self._temperature),
-            jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p),
-            jnp.asarray(self._greedy),
-        )
+        if self.paged is not None:
+            active = self._paged_reserve_active(active, 1)
+            if not active:
+                return True
+            next_tok = self._paged_decode_dispatch(active, 1, sub)
+            next_tok = next_tok[:, 0]
+        else:
+            next_tok, self.cache = self._decode(
+                self.params, self.cache,
+                jnp.asarray(self.slot_last_token),
+                sub,
+                jnp.asarray(self._temperature),
+                jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                jnp.asarray(self._greedy),
+            )
         next_host = np.asarray(next_tok)
         keys = sum(CostModel.block_keys(1, int(self.slot_len[s]))
                    for s in active)
@@ -2117,6 +3011,57 @@ class InferenceEngine:
         if self._stop.is_set():
             return False
         return self._thread is None or self._thread.is_alive()
+
+    # --- introspection -------------------------------------------------------
+
+    def debug_kv(self) -> dict:
+        """The ``GET /debug/kv`` payload: page-pool occupancy, sharing,
+        fragmentation, refcount histogram, and per-slot block-table
+        sizes (docs/paged-kv.md). Contiguous engines report their fixed
+        reservation so the endpoint exists under either layout."""
+        if self.paged is None:
+            return {
+                "layout": "contiguous",
+                "max_slots": self.max_slots,
+                "cache_len": self.cache_len,
+                "kv_tokens_reserved": self.max_slots * self.cache_len,
+            }
+        snap = self.paged.debug_snapshot()
+        live = 0
+        for s in range(self.max_slots):
+            # lock-free read from HTTP/scrape threads: the engine thread
+            # pops slot_prefill concurrently, so membership-then-
+            # subscript would be a TOCTOU KeyError — snapshot with .get
+            st = self.slot_prefill.get(s)
+            if st is not None:
+                live += int(st["done"])
+            elif self.slot_req[s] is not None:
+                live += int(self.slot_len[s])
+        mapped_tokens = snap["pages_slot_mapped"] * self.paged.page_size
+        snap["live_tokens"] = live
+        # internal fragmentation: allocated-but-unfilled slack of the
+        # slot-mapped pages (tail of each slot's last page + reserved
+        # decode headroom) — the waste the CONTIGUOUS layout suffers at
+        # (cache_len - context) per slot, shrunk to < page_size here
+        snap["fragmentation"] = (
+            round(1.0 - live / mapped_tokens, 4) if mapped_tokens else 0.0)
+        snap["preemptions"] = self.preemptions
+        snap["rejected_too_large"] = self.rejected_too_large
+        if self.prefix_cache is not None:
+            snap["prefix_index_entries"] = self.prefix_cache.n_entries
+        return snap
+
+    def page_capacity_detail(self, prompt_tokens: int) -> dict:
+        """Why a prompt 422s: the page math for the API error body."""
+        from llm_in_practise_tpu.serve.paged_kv import pages_for
+
+        P = self.paged.page_size
+        return {
+            "prompt_tokens": prompt_tokens,
+            "page_size": P,
+            "pages_needed": pages_for(prompt_tokens + 1, P),
+            "pages_capacity": self.paged.pool.capacity,
+        }
 
     # --- convenience ---------------------------------------------------------
 

@@ -71,6 +71,19 @@ class HostEntry:
     rows: list                  # per-layer {name: np.ndarray}
     last_logits: np.ndarray     # (1, vocab) logits at the final position
     slot_axis: int = 0          # cache layout of the rows (PrefixEntry)
+    # page-wise entries (kv_layout="paged" producers): rows span
+    # ceil(length / page_size) * page_size positions — only live pages
+    # travel, not a pow2 bucket (a 200-token prompt ships 208 rows at
+    # page_size 16 where the bucket path shipped 256). 0 = legacy
+    # bucket-width entry. Consumers of either layout accept both.
+    page_size: int = 0
+
+    @property
+    def pages(self) -> int:
+        """Live pages this entry spans (0 for legacy bucket entries)."""
+        if self.page_size <= 0:
+            return 0
+        return -(-self.length // self.page_size)
 
 
 def entry_to_host(entry) -> HostEntry:
@@ -85,24 +98,60 @@ def entry_to_host(entry) -> HostEntry:
         rows=rows,
         last_logits=np.asarray(jax.device_get(entry.last_logits)),
         slot_axis=getattr(entry, "slot_axis", 0),
+        page_size=getattr(entry, "page_size", 0),
     )
+
+
+def effective_bucket(entry) -> int:
+    """The row width a CONTIGUOUS consumer ends up holding for
+    ``entry``: page-aligned (non-pow2) widths are pow2-padded by
+    :func:`entry_to_device`, so every cache-fit filter on the consumer
+    side must bound THIS width, not the wire width."""
+    b = entry.bucket
+    if getattr(entry, "page_size", 0) > 0 and b & (b - 1):
+        return 1 << (b - 1).bit_length()
+    return b
 
 
 def entry_to_device(host: HostEntry):
     """:class:`HostEntry` -> device ``PrefixEntry`` (replicated placement;
-    a TP engine's jitted programs reshard on first use)."""
+    a TP engine's jitted programs reshard on first use).
+
+    Page-aligned entries (paged producers) are PADDED to the next pow2
+    width here, on host, before the upload: a contiguous consumer's
+    insert/suffix programs jit on the rows' width, and per-page-count
+    widths (208, 224, …) would each be a fresh XLA compile on the
+    serving path — pow2 padding restores the bounded
+    log2-variants compile set the bucket era had, at a few zero rows of
+    transfer. (Paged consumers never call this: they keep entries
+    host-side and page-scatter positions.)"""
     import jax
 
     from llm_in_practise_tpu.serve.prefix_cache import PrefixEntry
 
+    bucket = host.bucket
+    rows = host.rows
+    padded = effective_bucket(host)
+    if padded != bucket:
+        seq_axis = host.slot_axis + 1
+        rows = []
+        for layer in host.rows:
+            d = {}
+            for k, v in layer.items():
+                widths = [(0, 0)] * v.ndim
+                widths[seq_axis] = (0, padded - v.shape[seq_axis])
+                d[k] = np.pad(v, widths)
+            rows.append(d)
+        bucket = padded
     rows = [{k: jax.device_put(v) for k, v in layer.items()}
-            for layer in host.rows]
+            for layer in rows]
     return PrefixEntry(
         length=host.length,
-        bucket=host.bucket,
+        bucket=bucket,
         rows=rows,
         last_logits=jax.device_put(host.last_logits),
         slot_axis=host.slot_axis,
+        page_size=getattr(host, "page_size", 0),
     )
 
 
@@ -123,6 +172,7 @@ def encode_entry(host: HostEntry) -> bytes:
         "length": host.length,
         "bucket": host.bucket,
         "slot_axis": host.slot_axis,
+        "page_size": host.page_size,
         "rows": manifest_rows,
         "last_logits": {"shape": list(logits.shape),
                         "dtype": logits.dtype.name},
@@ -151,6 +201,7 @@ def decode_entry(blob: bytes) -> HostEntry:
             for layer in manifest["rows"]]
     return HostEntry(length=manifest["length"], bucket=manifest["bucket"],
                      slot_axis=int(manifest.get("slot_axis", 0)),
+                     page_size=int(manifest.get("page_size", 0)),
                      rows=rows, last_logits=take(manifest["last_logits"]))
 
 
@@ -277,9 +328,14 @@ class KVPoolServer:
         self.handoff_ttl_s = handoff_ttl_s
         self.max_handoff_bytes = max_handoff_bytes
         self._clock = clock or time.monotonic
-        # (ns, id) -> (expires_at, length, bucket, blob)
-        self._handoff: dict[tuple[str, str], tuple[float, int, int, bytes]] = {}  # guarded-by: _acct_lock
+        # (ns, id) -> (expires_at, length, bucket, blob, pages)
+        self._handoff: dict[tuple[str, str], tuple[float, int, int, bytes, int]] = {}  # guarded-by: _acct_lock
         self._handoff_bytes = 0  # guarded-by: _acct_lock
+        # page-wise accounting (paged producers): pinned live pages and
+        # their mean byte weight — the ``hput`` header carries the
+        # entry's page count, so budgets and TTL reclaim are attributable
+        # per page, not just per opaque blob
+        self._handoff_pages = 0  # guarded-by: _acct_lock
         self.handoff_puts = 0
         self.handoff_claims = 0
         self.handoff_expired = 0
@@ -391,6 +447,10 @@ class KVPoolServer:
         reg.gauge_func("kvpool_handoff_bytes",
                        lambda: self.handoff_bytes,
                        "bytes pinned by unclaimed handoff entries")
+        reg.gauge_func("kvpool_handoff_pages",
+                       lambda: self.handoff_pages,
+                       "live KV pages pinned by unclaimed page-wise "
+                       "handoff entries (0 for bucket-width producers)")
         return reg
 
     def metrics_text(self) -> str:
@@ -479,7 +539,8 @@ class KVPoolServer:
         elif op == "hput":
             ok, why = self._handoff_put(ns, str(header["id"]),
                                         int(header["length"]),
-                                        int(header["bucket"]), payload)
+                                        int(header["bucket"]), payload,
+                                        pages=int(header.get("pages", 0)))
             _send_msg(sock, {"ok": ok} if ok else {"ok": False, "error": why})
         elif op == "hclaim":
             found = self._handoff_claim(ns, str(header["id"]))
@@ -506,6 +567,7 @@ class KVPoolServer:
                 "conn_errors": self.conn_errors,
                 "handoff_pending": handoff_pending,
                 "handoff_bytes": handoff_bytes,
+                "handoff_pages": self.handoff_pages,
                 "handoff_puts": self.handoff_puts,
                 "handoff_claims": self.handoff_claims,
                 "handoff_expired": self.handoff_expired,
@@ -536,6 +598,11 @@ class KVPoolServer:
     def handoff_bytes(self) -> int:
         with self._acct_lock:
             return self._handoff_bytes
+
+    @property
+    def handoff_pages(self) -> int:
+        with self._acct_lock:
+            return self._handoff_pages
 
     @property
     def handoff_pending(self) -> int:
@@ -602,14 +669,18 @@ class KVPoolServer:
 
     def _sweep_handoff_locked(self, now: float) -> None:
         """Reclaim expired handoff entries — the TTL is the only eviction
-        pressure pinned entries feel. Caller holds ``_acct_lock``."""
+        pressure pinned entries feel. Caller holds ``_acct_lock``.
+        Reclaim is attributed per page as well as per blob: the pages
+        counter drops by exactly the expired entries' page counts."""
         dead = [k for k, v in self._handoff.items() if v[0] <= now]
         for k in dead:
-            self._handoff_bytes -= len(self._handoff.pop(k)[3])
+            entry = self._handoff.pop(k)
+            self._handoff_bytes -= len(entry[3])
+            self._handoff_pages -= entry[4]
             self.handoff_expired += 1
 
     def _handoff_put(self, ns: str, hid: str, length: int, bucket: int,
-                     blob: bytes) -> tuple[bool, str]:
+                     blob: bytes, pages: int = 0) -> tuple[bool, str]:
         # per-entry size is already bounded at the framing layer
         # (_recv_msg caps payloads at max_payload before dispatch);
         # the budget below is the only handoff-specific bound
@@ -628,8 +699,9 @@ class KVPoolServer:
                 self.handoff_rejected += 1
                 return False, "handoff byte budget exhausted"
             self._handoff_bytes += len(blob) - freed
+            self._handoff_pages += pages - (old[4] if old else 0)
             self._handoff[(ns, hid)] = (
-                now + self.handoff_ttl_s, length, bucket, blob)
+                now + self.handoff_ttl_s, length, bucket, blob, pages)
             self.handoff_puts += 1
         return True, ""
 
@@ -640,8 +712,9 @@ class KVPoolServer:
             found = self._handoff.pop((ns, hid), None)
             if found is None:
                 return None
-            _, length, bucket, blob = found
+            _, length, bucket, blob, pages = found
             self._handoff_bytes -= len(blob)
+            self._handoff_pages -= pages
             self.handoff_claims += 1
         return length, bucket, blob
 
@@ -703,7 +776,8 @@ class RemoteKVClient:
         this entry."""
         header, _ = self._call(
             {"op": "hput", "ns": self.namespace, "id": handoff_id,
-             "length": host.length, "bucket": host.bucket},
+             "length": host.length, "bucket": host.bucket,
+             "pages": host.pages},
             encode_entry(host))
         if not header.get("ok"):
             raise HandoffRejected(header.get("error", "handoff put refused"))
@@ -826,13 +900,18 @@ class TieredKV:
 
     # -- lookup path ----------------------------------------------------------
 
-    def lookup(self, prompt_ids, usable=None):
+    def lookup(self, prompt_ids, usable=None, *, device: bool = True):
         """Longest host/remote prefix as a device ``PrefixEntry`` (or None).
 
         ``usable(entry)`` may read ``entry.length``/``entry.bucket`` only
         (it sees :class:`HostEntry` here, device entries at L1) — applied
         *before* the device upload, and before promoting a remote hit
-        into the host pool, so unusable prefixes cost no transfers."""
+        into the host pool, so unusable prefixes cost no transfers.
+
+        ``device=False`` returns the :class:`HostEntry` itself (no
+        upload): paged engines scatter the rows page-by-page into the
+        slot's block table, so a whole-entry device buffer would be a
+        wasted transfer."""
         host = self.host_pool.lookup(prompt_ids, usable=usable)
         if host is None and self._remote_ok():
             t0 = self._clock()
@@ -855,7 +934,7 @@ class TieredKV:
                 self.host_pool.put(prompt_ids, host)
         if host is None:
             return None
-        return entry_to_device(host)
+        return host if not device else entry_to_device(host)
 
 
 def main() -> None:

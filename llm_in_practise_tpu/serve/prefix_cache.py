@@ -27,6 +27,7 @@ logic with different value types.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import threading
 from collections import OrderedDict
 
@@ -45,6 +46,12 @@ class PrefixEntry:
     # transposed relative to its writes (shared kv_pool / restart with
     # the layout toggled) — so lookup filters on this.
     slot_axis: int = 0
+    # Page-wise entries (kv_layout="paged" producers): rows span
+    # ceil(length / page_size) * page_size positions — only live pages,
+    # not a pow2 bucket. 0 = legacy bucket-width entry. Consumers of
+    # either layout accept both; the field exists so wire accounting
+    # (kv_pool) can count pages and so a reader knows the width law.
+    page_size: int = 0
 
 
 class PrefixLRU:
@@ -171,6 +178,216 @@ class PrefixCache(PrefixLRU):
             if entry.length == len(prompt_ids):
                 self.full_hits += 1
         return entry
+
+
+@dataclasses.dataclass
+class _PageEntry:
+    eid: int              # this entry's chain id (children key on it)
+    page: int             # physical page holding the KV rows
+    parent_eid: int       # 0 = chain root
+
+
+class PagedPrefixIndex:
+    """Page-granular prefix sharing for ``kv_layout="paged"`` engines —
+    the vLLM automatic-prefix-caching idiom at its native grain.
+
+    Where :class:`PrefixCache` stores COPIED rows keyed by whole token
+    tuples (hit = longest exact entry, all-or-nothing per entry), this
+    index maps **hash-per-page chains to the physical pages
+    themselves**: page ``i`` of a prompt is keyed by
+    ``(parent_entry_id, tokens_of_page_i)``, where ``parent_entry_id``
+    identifies the entry for pages ``0..i-1``. A lookup walks the chain
+    and returns every consecutively matched FULL page — a new request
+    sharing 3 of a cached prompt's 5 pages reuses exactly those 3
+    physical pages (refcounted, zero device copies) and prefills only
+    the tail. The exact-token chain keys make collisions impossible (a
+    content-hash scheme would need a verify pass; vLLM compares block
+    tokens the same way).
+
+    Copy-on-write contract: only FULL pages are ever indexed, a hit is
+    capped at ``(len(prompt) - 1) // page_size`` pages (the engine must
+    recompute at least the final position to obtain next-token logits),
+    and slots therefore never write inside a shared page — the engine's
+    defensive fork (:meth:`InferenceEngine._paged_cow_fork`) covers any
+    future path that would.
+
+    Refcounts: the index holds ONE pool reference per indexed page
+    (taken at :meth:`register`); every lookup hit takes one more per
+    matched page on the caller's behalf. Eviction (LRU under a token
+    budget, or on-demand through :class:`~.paged_kv.PagePool`'s
+    ``reclaim`` hook when admission runs dry) drops the index's
+    reference — pages still mapped by live slots survive until those
+    slots release them. Evicting an entry cascades to its descendants:
+    a child whose parent is gone can never match again, and letting it
+    linger would pin its page forever.
+
+    Counter names mirror :class:`PrefixCache` so the
+    ``llm_prefix_cache_*`` metric plumbing reads either implementation
+    unchanged; ``full_hits`` counts maximal hits (every matchable page
+    of the prompt matched).
+    """
+
+    def __init__(self, pool, *, max_tokens: int = 32768,
+                 min_prefix: int | None = None):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self.max_tokens = max_tokens
+        self.min_prefix = (min_prefix if min_prefix is not None
+                           else pool.page_size)
+        self._lock = threading.Lock()
+        # (parent_eid, page-token tuple) -> _PageEntry, LRU-ordered
+        self._entries: "OrderedDict[tuple, _PageEntry]" = OrderedDict()  # guarded-by: _lock
+        self._children: dict[int, list[tuple]] = {}  # guarded-by: _lock
+        self._eid = itertools.count(1)
+        self.hits = 0           # guarded-by: _lock
+        self.misses = 0         # guarded-by: _lock
+        self.full_hits = 0      # guarded-by: _lock
+        self.tokens_saved = 0   # guarded-by: _lock
+
+    @property
+    def n_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def cached_tokens(self) -> int:
+        with self._lock:
+            return len(self._entries) * self.page_size
+
+    def _chain_keys(self, token_ids):
+        """Yield each full page's ``(page_index, tokens)`` in order."""
+        P = self.page_size
+        for i in range(len(token_ids) // P):
+            yield i, tuple(token_ids[i * P: (i + 1) * P])
+
+    def lookup(self, prompt_ids) -> list[int]:
+        """Physical pages holding the longest indexed full-page prefix
+        of ``prompt_ids`` (possibly empty). One pool reference per
+        returned page is taken FOR THE CALLER — map them into a block
+        table or release them."""
+        plen = len(prompt_ids)
+        # at least the last position must be recomputed for its logits
+        max_pages = max(0, (plen - 1) // self.page_size)
+        pages: list[int] = []
+        with self._lock:
+            parent = 0
+            for i, toks in self._chain_keys(prompt_ids):
+                if i >= max_pages:
+                    break
+                entry = self._entries.get((parent, toks))
+                if entry is None:
+                    break
+                self._entries.move_to_end((parent, toks))
+                pages.append(entry.page)
+                parent = entry.eid
+            if len(pages) * self.page_size < self.min_prefix:
+                # too-short hits aren't worth the bookkeeping — the
+                # same floor PrefixCache applies (no refs taken yet:
+                # share() runs below, only for returned pages)
+                pages = []
+            if not pages:
+                self.misses += 1
+                return []
+            self.hits += 1
+            if len(pages) == max_pages:
+                self.full_hits += 1
+            self.tokens_saved += len(pages) * self.page_size
+        self.pool.share(pages)
+        return pages
+
+    def register(self, token_ids, pages: list[int]) -> int:
+        """Index every full page of ``token_ids`` whose chain position
+        is not yet present; ``pages[i]`` must be the physical page
+        holding positions ``[i*P, (i+1)*P)``. Returns how many new
+        entries were created (each pinned with one pool reference)."""
+        if len(token_ids) < self.min_prefix:
+            return 0
+        new_pages: list[int] = []
+        evict: list[int] = []
+        with self._lock:
+            parent = 0
+            created = 0
+            for i, toks in self._chain_keys(token_ids):
+                if i >= len(pages):
+                    break
+                key = (parent, toks)
+                entry = self._entries.get(key)
+                if entry is not None:
+                    # chain position already indexed (maybe by another
+                    # slot's identical prefix) — reuse ITS entry; the
+                    # registering slot keeps its private copy
+                    self._entries.move_to_end(key)
+                    parent = entry.eid
+                    continue
+                entry = _PageEntry(eid=next(self._eid),
+                                   page=int(pages[i]),
+                                   parent_eid=parent)
+                self._entries[key] = entry
+                self._children.setdefault(parent, []).append(key)
+                new_pages.append(entry.page)
+                parent = entry.eid
+                created += 1
+            while (len(self._entries) * self.page_size > self.max_tokens
+                   and len(self._entries) > 1):
+                evict.extend(self._evict_lru_locked())
+        if new_pages:
+            self.pool.share(new_pages)
+        if evict:
+            self.pool.release(evict)
+        return created
+
+    def _evict_locked(self, key) -> list[int]:
+        """Remove ``key`` and every descendant; returns their pages
+        (caller releases OUTSIDE the lock — PagePool has its own).
+        Iterative worklist, NOT recursion: one long conversation indexes
+        as one parent-child chain, so a cache_len=32K/page_size=16 chain
+        root has ~2K descendants — deeper than Python's recursion
+        limit."""
+        root = self._entries.get(key)
+        if root is None:
+            return []
+        siblings = self._children.get(root.parent_eid)
+        if siblings is not None:
+            try:
+                siblings.remove(key)
+            except ValueError:
+                pass
+        pages: list[int] = []
+        work = [key]
+        while work:
+            entry = self._entries.pop(work.pop(), None)
+            if entry is None:
+                continue
+            pages.append(entry.page)
+            work.extend(self._children.pop(entry.eid, []))
+        return pages
+
+    def _evict_lru_locked(self) -> list[int]:
+        if not self._entries:
+            return []
+        key = next(iter(self._entries))
+        return self._evict_locked(key)
+
+    def evict_pages(self, n: int) -> int:
+        """Reclaim hook for :class:`~.paged_kv.PagePool`: drop LRU
+        entries until ``n`` index references were released (the pages
+        become allocatable once no slot maps them). Returns how many
+        references were dropped."""
+        dropped: list[int] = []
+        with self._lock:
+            while len(dropped) < n and self._entries:
+                dropped.extend(self._evict_lru_locked())
+        if dropped:
+            self.pool.release(dropped)
+        return len(dropped)
+
+    def clear(self) -> None:
+        with self._lock:
+            pages = [e.page for e in self._entries.values()]
+            self._entries.clear()
+            self._children.clear()
+        if pages:
+            self.pool.release(pages)
 
 
 def slice_cache_rows(prefill_cache, bucket: int, *, axis: int = 1) -> list:

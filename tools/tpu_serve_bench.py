@@ -73,10 +73,19 @@ def main() -> None:
                         jnp.ones((1, 8), jnp.int32))["params"]
     decode_steps = int(os.environ.get("SERVE_DECODE_STEPS", "8"))
     mixed_step = os.environ.get("SERVE_MIXED_STEP", "1") != "0"
+    # --kv-layout A/B leg (docs/paged-kv.md): SERVE_KV_LAYOUT=paged
+    # serves the ladder off the block-table page pool (optionally
+    # SERVE_KV_POOL_TOKENS sized below max_slots*cache_len to run the
+    # concurrency ladder past a contiguous ceiling — the dedicated
+    # same-bytes A/B is tools/kv_layout_bench.py)
+    kv_layout = os.environ.get("SERVE_KV_LAYOUT", "contiguous")
+    kv_pool_tokens = os.environ.get("SERVE_KV_POOL_TOKENS")
     engine = InferenceEngine(
         model, params, max_slots=MAX_SLOTS, cache_len=1024,
         chunked_prefill=256, speculative_k=None,
         decode_steps=decode_steps, mixed_step=mixed_step,
+        kv_layout=kv_layout,
+        kv_pool_tokens=(int(kv_pool_tokens) if kv_pool_tokens else None),
     )
     engine.start()
     tok = ByteTokenizer()
@@ -163,6 +172,8 @@ def main() -> None:
                    "chunked_prefill": 256,
                    "decode_steps": decode_steps,
                    "mixed_step": mixed_step,
+                   "kv_layout": kv_layout,
+                   "debug_kv": engine.debug_kv(),
                    "mixed_blocks": engine.mixed_blocks,
                    "dispatches_per_step":
                        round(engine.dispatch_meter.mean_per_step, 3),
