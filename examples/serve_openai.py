@@ -78,7 +78,11 @@ def main():
                    nargs="?", const=4, default=None, metavar="K",
                    help="ngram/prompt-lookup speculative decoding: draft K "
                         "tokens per step, verify in one forward (lossless "
-                        "for greedy; vLLM ngram speculator parity)")
+                        "for greedy; vLLM ngram speculator parity). The "
+                        "fused spec round verifies the K drafts AND runs "
+                        "the rest of the --decode-steps block in ONE "
+                        "dispatch. DEFAULT ON for --role decode replicas "
+                        "(K=4) — pass --speculative 0 to disable there")
     p.add_argument("--decode-steps", dest="decode_steps", type=int,
                    default=1, metavar="N",
                    help="decode N tokens per jitted dispatch (vLLM "
@@ -186,8 +190,30 @@ def main():
         p.error("--scan-layers serves with --kv-layout contiguous only "
                 "(the paged pool supports the unrolled cache layout; "
                 "pass --kv-layout contiguous explicitly)")
+    # a draft model still needs an EXPLICIT K (checked before the
+    # decode-role default below resolves one, or the requirement would
+    # be silently bypassed on --role decode)
     if args.draft_model_path and args.speculative is None:
         p.error("--draft-model-path requires --speculative K")
+    # decode replicas default speculation ON (ISSUE 9 / ROADMAP item 4):
+    # the fused verify-inside-the-block round is the production decode
+    # path once no prefill ever shares the replica; --speculative 0
+    # opts out explicitly. Only the ngram proposer can be defaulted
+    # (the draft-model path was handled above).
+    from llm_in_practise_tpu.serve.disagg import default_speculative_k
+
+    resolved_spec = default_speculative_k(args.role, args.speculative)
+    if args.role == "decode" and args.speculative is None:
+        print(f"decode replica: ngram speculation ON by default "
+              f"(k={resolved_spec}; --speculative 0 disables)")
+    args.speculative = resolved_spec
+    if args.draft_model_path and args.speculative is None:
+        # --speculative 0 resolved the opt-out: a draft model with
+        # speculation off is contradictory — fail at the CLI, not with
+        # an engine ValueError traceback after the checkpoint loads
+        p.error("--draft-model-path with --speculative 0 is "
+                "contradictory: drop the draft model or pass a "
+                "positive K")
     if args.draft_model_path and args.scan_layers:
         p.error("--draft-model-path with --scan-layers is not supported "
                 "yet: the draft loads unstacked (cache slot axis 0) while "

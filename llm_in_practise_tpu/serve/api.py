@@ -696,10 +696,35 @@ class OpenAIServer:
                              "prompts refused at submit: pages needed "
                              "exceed pool capacity (HTTP 422)")
         if eng.speculative_k is not None:
-            reg.counter_func("llm_spec_tokens_proposed_total",
-                             lambda: eng.spec_proposed)
-            reg.counter_func("llm_spec_tokens_accepted_total",
-                             lambda: eng.spec_accepted)
+            # speculation plane (ISSUE 9): proposed/accepted drafted
+            # tokens, fused verify dispatches, the tokens those
+            # dispatches committed (accepted + bonus + extension), and
+            # a ready-made acceptance-rate gauge — the live "is the
+            # spec bet paying" dial next to llm_dispatch_hbm_bw_util
+            reg.counter_func("llm_spec_proposed_total",
+                             lambda: eng.spec_proposed,
+                             "drafted tokens submitted to verify")
+            reg.counter_func("llm_spec_accepted_total",
+                             lambda: eng.spec_accepted,
+                             "drafted tokens the verify accepted")
+            reg.counter_func("llm_spec_rounds_total",
+                             lambda: eng.spec_rounds,
+                             "fused spec-verify dispatches issued")
+            reg.counter_func("llm_spec_round_tokens_total",
+                             lambda: eng.spec_round_tokens,
+                             "tokens committed by spec dispatches "
+                             "(accepted + bonus + block extension)")
+
+            def _acceptance():
+                proposed = eng.spec_proposed     # snapshot: torn reads
+                accepted = eng.spec_accepted     # stay <= 1.0
+                if proposed <= 0:
+                    return []
+                return [({}, min(accepted / proposed, 1.0))]
+
+            reg.gauge_func("llm_spec_acceptance_rate", _acceptance,
+                           "lifetime accepted/proposed drafted tokens "
+                           "(no samples until the first draft)")
         if getattr(eng, "decode_steps", 1) > 1:
             # operators tuning --decode-steps need to see whether blocks
             # actually run (the gate silently falls back to single-step)

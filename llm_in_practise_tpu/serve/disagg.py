@@ -72,6 +72,30 @@ def validate_roles(role: str) -> str:
     return role
 
 
+#: default ngram proposal length for decode replicas (ISSUE 9 /
+#: ROADMAP item 4): decode is bandwidth-bound, a decode replica never
+#: prefills by design, and the fused spec round is greedy-lossless —
+#: so speculation is the production default there, not an opt-in.
+DECODE_DEFAULT_SPEC_K = 4
+
+
+def default_speculative_k(role: str, requested: int | None) -> int | None:
+    """Resolve the serving CLI's ``--speculative`` value for ``role``.
+
+    ``--role decode`` replicas default speculation ON
+    (:data:`DECODE_DEFAULT_SPEC_K`, the ngram proposer — no extra
+    weights, lossless under greedy, and the fused verify rides the
+    multi-step dispatch so it composes with ``--decode-steps``).
+    An explicit ``--speculative 0`` opts out; any positive value is
+    passed through; other roles keep speculation opt-in.
+    """
+    if requested == 0:
+        return None
+    if requested is None and role == "decode":
+        return DECODE_DEFAULT_SPEC_K
+    return requested
+
+
 class LocalHandoff:
     """In-process handoff store: pin-until-claimed dict with TTL reclaim.
 

@@ -56,6 +56,8 @@ from llm_in_practise_tpu.serve.mixed_step import (
     make_mixed_step,
     pin_index,
     plan_decode_block,
+    plan_spec_extension,
+    spec_verify_block,
 )
 
 
@@ -307,14 +309,41 @@ class InferenceEngine:
                 f"kv_layout must be 'paged' or 'contiguous', got "
                 f"{kv_layout!r}")
         self.paged = None
+        self.draft_kv_reserved_tokens = 0
         if kv_layout == "paged":
-            from llm_in_practise_tpu.serve.paged_kv import PagedKV
+            from llm_in_practise_tpu.serve.paged_kv import (
+                PagedKV,
+                kv_row_bytes,
+            )
 
+            pool_request = (kv_pool_tokens if kv_pool_tokens is not None
+                            else max_slots * self.cache_len)
+            if draft_model is not None and kv_pool_tokens is not None:
+                # The draft cache is a CONTIGUOUS max_slots x cache_len
+                # reservation living NEXT TO the page pool. An explicit
+                # --kv-pool-tokens models the operator's KV byte budget,
+                # so the draft's bytes come out of it (token-equivalent
+                # at the target's bytes/row) — a paged engine with a
+                # draft model must not over-admit against memory the
+                # draft cache already spent. The default pool size keeps
+                # worst-case reservation semantics (over-admission is
+                # impossible there), so nothing is deducted.
+                drow = kv_row_bytes(draft_model, cache_dtype)
+                trow = kv_row_bytes(model, cache_dtype)
+                self.draft_kv_reserved_tokens = -(
+                    -max_slots * self.cache_len * drow // trow)
+                pool_request -= self.draft_kv_reserved_tokens
+                if pool_request < 2 * kv_page_size:
+                    raise ValueError(
+                        f"kv_pool_tokens={kv_pool_tokens} leaves only "
+                        f"{pool_request} tokens after the draft cache's "
+                        f"{self.draft_kv_reserved_tokens}-token "
+                        "equivalent reservation — raise the pool budget "
+                        "or drop the draft model")
             self.paged = PagedKV(
                 model, max_slots=max_slots, cache_len=self.cache_len,
                 page_size=kv_page_size,
-                pool_tokens=(kv_pool_tokens if kv_pool_tokens is not None
-                             else max_slots * self.cache_len),
+                pool_tokens=pool_request,
                 dtype=cache_dtype, mesh=mesh)
             # no contiguous engine cache exists in this layout; the
             # jitted paged programs gather transient views from the pool
@@ -453,6 +482,12 @@ class InferenceEngine:
         self.slot_hist: list[list[int] | None] = [None] * max_slots
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # fused spec-round accounting (the BENCH_SPEC_LADDER evidence):
+        # rounds = spec-verify dispatches issued; round_tokens = tokens
+        # those dispatches actually committed (accepted + bonus +
+        # extension) — tokens/dispatch on the spec path in two ints
+        self.spec_rounds = 0
+        self.spec_round_tokens = 0
         # Draft-MODEL speculation (vLLM draft-model / Eagle-style
         # proposer parity; the ngram speculator above is prompt-lookup):
         # a small model with its OWN slot KV cache proposes the k tokens
@@ -486,10 +521,12 @@ class InferenceEngine:
                 layer["index"] = jnp.zeros((self.max_slots,), jnp.int32)
             self._draft_sync = np.zeros((max_slots,), np.int64)
             self._draft_uid = np.full((max_slots,), -1, np.int64)
-            # catch-up window: biggest normal re-sync is k+1 (a fully
-            # accepted round) or decode_steps (a non-spec block)
+            # catch-up window: biggest normal re-sync is a fully
+            # accepted FUSED round — k+1 verify tokens plus the
+            # decode_steps-1 extension (spec_verify_block) — or a
+            # plain decode_steps block
             self._draft_window = max(
-                16, 1 << (max(speculative_k + 1, decode_steps)
+                16, 1 << (speculative_k + decode_steps
                           - 1).bit_length())
         # Multi-step decode (vLLM multi-step scheduling parity): run
         # ``decode_steps`` decode iterations inside ONE jitted call
@@ -499,8 +536,10 @@ class InferenceEngine:
         # fine. Block length is planned per step by
         # :func:`llm_in_practise_tpu.serve.mixed_step.plan_decode_block`
         # (soonest-completion cap under queueing, chunk-window caps while
-        # prompts prefill); it is never combined with speculative
-        # decoding (spec already batches). Slots that finish mid-block
+        # prompts prefill); a speculative engine rides the SAME plan —
+        # the fused spec round (serve/mixed_step.spec_verify_block)
+        # verifies the k drafts and decodes the block's remaining n-1
+        # steps in one dispatch. Slots that finish mid-block
         # waste their remaining rows; the freed slot's rows/index are
         # reset on reuse by the insert path (the same contract the
         # speculative burst relies on).
@@ -602,8 +641,8 @@ class InferenceEngine:
                                         donate_argnums=(1,),
                                         static_argnames=("n",)))
         self._decode_spec = _c(jax.jit(self._decode_spec_fn,
-                                       donate_argnums=(1,)))
-        self._rewind = _c(jax.jit(self._rewind_fn, donate_argnums=(0,)))
+                                       donate_argnums=(1,),
+                                       static_argnames=("m",)))
         self._prefill = _c(jax.jit(self._prefill_fn))
         self._prefill_suffix = _c(jax.jit(self._prefill_suffix_fn))
         self._insert = _c(jax.jit(self._insert_fn, donate_argnums=(0,),
@@ -636,7 +675,8 @@ class InferenceEngine:
                                         donate_argnums=(1,),
                                         static_argnames=("n",)))
             self._pg_spec = _c(jax.jit(self._paged_spec_fn,
-                                       donate_argnums=(1,)))
+                                       donate_argnums=(1,),
+                                       static_argnames=("m",)))
             self._pg_chunk = _c(jax.jit(self._paged_chunk_fn,
                                         donate_argnums=(1,)))
             self._pg_mixed = _c(jax.jit(self._paged_mixed_fn,
@@ -701,21 +741,14 @@ class InferenceEngine:
         return decode_scan(self.model, params, cache, tokens, rng,
                            temperature, top_k, top_p, greedy, n=n)
 
-    def _decode_spec_fn(self, params, cache, tokens):
-        """Verify step: tokens (B, K+1); returns greedy continuations at
-        every position (B, K+1) + cache advanced by K+1 per slot."""
-        logits, cache = self.model.apply(
-            {"params": params}, tokens, deterministic=True, cache=cache
-        )
-        out = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
-        return out, cache
-
-    def _rewind_fn(self, cache, delta):
-        """Pull each slot's write index back by ``delta`` (B,) — the
-        rejected draft positions. Rows beyond the index are never attended
-        (causal mask keys off absolute position) and are overwritten in
-        order before the index reaches them, so the stale KV is inert."""
-        return [dict(layer, index=layer["index"] - delta) for layer in cache]
+    def _decode_spec_fn(self, params, cache, tokens, base, mask, *, m):
+        """Fused speculative round (serve/mixed_step.spec_verify_block):
+        verify the (B, K+1) proposed tokens, accept on DEVICE, fix the
+        per-slot index (the work of the old separate ``_rewind``
+        dispatch), and decode the planned block's remaining ``m`` steps
+        — one dispatch per spec round, however long the block."""
+        return spec_verify_block(self.model, params, cache, tokens,
+                                 base, mask, m=m)
 
     def _prefill_fn(self, params, prompt_ids, length):
         """prompt_ids: (B, bucket), length: (B,). Returns per-request
@@ -1109,13 +1142,20 @@ class InferenceEngine:
                                  temperature, top_k, top_p, greedy, n=n)
         return toks, self._paged_writeback(pool, view, sidx, index_vec)
 
-    def _paged_spec_fn(self, params, pool, gidx, index_vec, sidx, tokens):
+    def _paged_spec_fn(self, params, pool, gidx, index_vec, sidx, tokens,
+                       mask, *, m):
         view = self._paged_view(pool, gidx, index_vec)
-        out, view = self._decode_spec_fn(params, view, tokens)
-        # no device rewind in this layout: the per-dispatch index is
-        # derived from host slot_len, and rejected rows' page contents
-        # are overwritten in place by the next real write
-        return out, self._paged_writeback(pool, view, sidx, index_vec)
+        # base = the pinned per-dispatch index; the block body's index
+        # fixup matters only within the view (the pool derives each
+        # dispatch's index from host slot_len), but the ACCEPTANCE and
+        # the m-step extension run on device exactly like the
+        # contiguous twin — rejected rows' page contents are either
+        # overwritten by the extension in order or by the next real
+        # write
+        out, n_acc, extra, view = spec_verify_block(
+            self.model, params, view, tokens, index_vec, mask, m=m)
+        return out, n_acc, extra, self._paged_writeback(
+            pool, view, sidx, index_vec)
 
     def _paged_chunk_fn(self, params, pool, gidx, chunk_ids, starts,
                         lens, sidx):
@@ -1198,7 +1238,10 @@ class InferenceEngine:
         active rows at their true length (the caller sized ``W`` so
         their writes fit un-clamped), mid-prefill rows at ``done``,
         free rows at 0 — clamped so even dead in-view writes stay
-        inside the view (their scatter targets are trash anyway)."""
+        inside the view (their scatter targets are trash anyway).
+        Reads only host slot state, nothing paged: the CONTIGUOUS
+        fused spec round reuses it with ``W = cache_len`` so the
+        slot-state → index convention has one definition."""
         idx = np.zeros((self.max_slots,), np.int32)
         for s in range(self.max_slots):
             if s in self.slot_prefill:
@@ -2527,20 +2570,39 @@ class InferenceEngine:
                 and all(st["done"] + k + 1 <= self.cache_len
                         for st in self.slot_prefill.values()))
 
+    def _spec_headroom(self, active: list[int]) -> int:
+        """Cache rows available for the spec extension ABOVE the k+1
+        verify rows — min over decoding and mid-prefill rows (their
+        dead write windows widen with the extension too)."""
+        k = self.speculative_k
+        lens = [int(self.slot_len[s]) for s in active]
+        lens += [st["done"] for st in self.slot_prefill.values()]
+        return self.cache_len - (k + 1) - (max(lens) if lens else 0)
+
     def _try_speculative(self, active: list[int]) -> bool:
-        """Run one verify-step over drafted tokens; returns False when the
-        spec path doesn't apply this step (caller falls back to decode)."""
+        """One FUSED speculative round (the ROADMAP item 4 tentpole):
+        draft k tokens per slot (ngram or draft model), then verify +
+        accept + decode the planned block's remaining steps inside ONE
+        jitted dispatch (serve/mixed_step.spec_verify_block) — the old
+        path paid a second ``_rewind`` dispatch on the contiguous
+        layout and capped every round at ``decode_steps=1`` economics.
+        Returns False when the spec path doesn't apply this step
+        (caller falls back to plain decode)."""
         k = self.speculative_k
         if not self._spec_applicable(active):
             return False
-        if self.paged is not None:
-            # the k+1-wide verify writes k+1 rows per slot: reserve the
-            # pages up front (preempting youngest slots if dry) — the
-            # speculative watermark of any preempted slot is reset in
-            # _paged_preempt, so a recycled draft cache re-syncs
-            active = self._paged_reserve_active(active, k + 1)
-            if not active:
-                return True
+        # the extension m rides the SAME token-budget plan as a plain
+        # block (soonest-finish cap under queueing, chunk caps while
+        # prefilling): one fused dispatch spans verify + m greedy
+        # steps, so acceptance-count is part of the dispatch plan and
+        # the compile set stays pow2-bounded
+        m = plan_spec_extension(block=self._plan_block(active), k=k,
+                                headroom=self._spec_headroom(active))
+        # draft BEFORE touching the page pool: drafting needs no pool
+        # pages (ngram is host-side; the draft model's cache is its own
+        # contiguous buffer), so a draft-miss step returns to the plain
+        # path without having preempted or cache-finished anybody for a
+        # k+1+m reservation that would never be used
         if self.draft_model is not None:
             drafts = self._draft_model_propose(active, k)
         else:
@@ -2551,62 +2613,82 @@ class InferenceEngine:
                     drafts[s] = d             # un-padded, 1..k tokens
         if not drafts:
             return False                      # nothing to verify; plain step
+        if self.paged is not None:
+            # the fused round writes k+1+m rows per slot: reserve the
+            # pages up front (preempting youngest slots if dry) — the
+            # speculative watermark of any preempted slot is reset in
+            # _paged_preempt, so a recycled draft cache re-syncs
+            active = self._paged_reserve_active(active, k + 1 + m)
+            if not active:
+                return True
+            drafts = {s: d for s, d in drafts.items() if s in active}
         tokens = np.zeros((self.max_slots, k + 1), np.int32)
         tokens[:, 0] = self.slot_last_token
         for s, d in drafts.items():
             tokens[s, 1: 1 + len(d)] = d
+        mask = np.zeros((self.max_slots,), np.int32)
+        mask[active] = 1
         t0 = time.monotonic()
         if self.paged is not None:
             W = self._paged_width(
-                max(int(self.slot_len[s]) for s in active) + k + 1)
-            idxv = self._paged_index_vec(W, k + 1)
+                max(int(self.slot_len[s]) for s in active) + k + 1 + m)
+            idxv = self._paged_index_vec(W, k + 1 + m)
             valid = np.zeros((self.max_slots,), np.int32)
             for s in active:
-                valid[s] = k + 1
-                self._paged_cow_fork(s, int(self.slot_len[s]), k + 1)
-            out, self.paged.kv = self._pg_spec(
+                valid[s] = k + 1 + m
+                self._paged_cow_fork(s, int(self.slot_len[s]), k + 1 + m)
+            out, n_acc, extra, self.paged.kv = self._pg_spec(
                 self.params, self.paged.kv,
                 jnp.asarray(self.paged.gather_idx(W)),
                 jnp.asarray(idxv),
-                jnp.asarray(self.paged.scatter_idx(idxv, valid, k + 1)),
-                jnp.asarray(tokens))
+                jnp.asarray(self.paged.scatter_idx(idxv, valid,
+                                                   k + 1 + m)),
+                jnp.asarray(tokens), jnp.asarray(mask), m=m)
         else:
-            out, self.cache = self._decode_spec(
-                self.params, self.cache, jnp.asarray(tokens))
+            # per-row pinned index: the slot-state → index convention
+            # lives in ONE place (_paged_index_vec reads only host slot
+            # state — nothing paged about it); here the "view" is the
+            # whole contiguous cache, so W = cache_len. Free rows' dead
+            # k+1+m write window is clamped inside the cache; live rows
+            # already fit (_spec_applicable + the headroom cap on m),
+            # so their clamp is a no-op.
+            base = self._paged_index_vec(self.cache_len, k + 1 + m)
+            out, n_acc, extra, self.cache = self._decode_spec(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(base), jnp.asarray(mask), m=m)
         out_host = np.asarray(out)
+        acc_host = np.asarray(n_acc)
+        extra_host = np.asarray(extra)
         # the verify is ONE wide forward over k+1 positions per slot
-        # (that width amortizing the weight read is the whole spec bet
-        # — the decode MFU gauge shows it paying off or not). Useful
-        # positions only: an undrafted/short-draft slot's zero padding
-        # is wasted work and must read as lost MFU, same convention as
-        # the spec_proposed/spec_accepted counters below.
-        useful = {s: len(drafts.get(s, ())) + 1 for s in active}
+        # plus m single-token extension passes (that width amortizing
+        # the weight read is the whole spec bet — the decode MFU gauge
+        # shows it paying off or not). Useful positions only: an
+        # undrafted/short-draft slot's zero padding is wasted work and
+        # must read as lost MFU, same convention as the
+        # spec_proposed/spec_accepted counters below.
+        useful = {s: len(drafts.get(s, ())) + 1 + m for s in active}
         keys = sum(CostModel.block_keys(useful[s], int(self.slot_len[s]))
                    for s in active)
         self._note_device_phase(
             "decode", tokens=sum(useful.values()), attended_keys=keys,
-            weight_passes=1, kv_read_tokens=keys,
+            weight_passes=1 + m, kv_read_tokens=keys,
             dt=time.monotonic() - t0)
-        delta = np.zeros((self.max_slots,), np.int32)
+        self.spec_rounds += 1
         for s in active:
-            n_acc = 0
-            while n_acc < k and tokens[s, n_acc + 1] == out_host[s, n_acc]:
-                n_acc += 1
-            # metrics over real drafted positions only — zero padding (and
-            # undrafted slots' zero fill) must not inflate either counter
+            n_acc_s = int(acc_host[s])
+            # metrics over real drafted positions only — zero padding
+            # (and undrafted slots' zero fill) must not inflate either
+            # counter
             n_drafted = len(drafts.get(s, ()))
             self.spec_proposed += n_drafted
-            self.spec_accepted += min(n_acc, n_drafted)
-            delta[s] = k - n_acc              # (k+1) written, n_acc+1 used
-            for j in range(n_acc + 1):
+            self.spec_accepted += min(n_acc_s, n_drafted)
+            burst = [int(out_host[s, j]) for j in range(n_acc_s + 1)]
+            burst += [int(extra_host[s, j]) for j in range(m)]
+            for tok in burst:
                 if self.slot_req[s] is None:
                     break                     # finished mid-burst (eos/len)
-                self._commit_token(s, int(out_host[s, j]))
-        if self.paged is None:
-            # paged needs no rewind: the index is pinned from host
-            # slot_len each dispatch, and rejected rows' page contents
-            # are overwritten in place by the next real write
-            self.cache = self._rewind(self.cache, jnp.asarray(delta))
+                self._commit_token(s, tok)
+                self.spec_round_tokens += 1
         return True
 
     def _commit_token(self, slot: int, tok: int) -> None:
@@ -2839,18 +2921,24 @@ class InferenceEngine:
         self._admit()
         budget = self.prefill_budget
         active = self._ready_slots()
-        # A speculative engine left at decode_steps=1 keeps speculating
+        # A speculative engine at decode_steps=1 keeps speculating
         # while prompts prefill (the r5 composition): its verify step
         # yields 1+accepted tokens per dispatch, strictly more than the
         # fused step's single token at n=1 — suspending it there would
-        # REGRESS mixed-load TPOT on accepting workloads. With
-        # decode_steps>1 the fused block's amortization wins and spec
-        # is suspended below (greedy-lossless either way). Composition
-        # only applies when speculation actually CAN run this step —
+        # REGRESS mixed-load TPOT on accepting workloads. On a
+        # ``--role decode`` replica speculation NEVER suspends (ISSUE 9
+        # / ROADMAP item 4): prefill on such a replica is the rare
+        # degraded local-re-prefill path, and the fused spec round
+        # (verify + the block's remaining steps in one dispatch) beats
+        # the plain fused block at every decode_steps. Mixed
+        # (``--role both``) replicas with decode_steps>1 keep the
+        # documented suspend-during-prefill behavior: there the fused
+        # mixed step's chunk+block amortization wins. Composition only
+        # applies when speculation actually CAN run this step —
         # non-greedy traffic on a spec engine must not lose the fused
         # step too.
         spec_composes = (
-            self.decode_steps == 1
+            (self.decode_steps == 1 or self.role == "decode")
             and self._spec_applicable(active)
             # the verify runs AFTER this step's chunks advance each
             # prefill row (by up to budget chunks) — account for that
@@ -2884,7 +2972,15 @@ class InferenceEngine:
                 n = self._plan_block(active)
                 ok, why = self._mixed_feasible(active, n)
                 if ok:
+                    # the decode-replica suspension gate is GONE
+                    # (ISSUE 9 satellite): on role="decode" the branch
+                    # above composes speculation whenever it can run at
+                    # all, so reaching here means spec was inapplicable
+                    # (non-greedy traffic / cache tail) — logging
+                    # "suspended" would be noise. Only mixed replicas
+                    # still suspend by policy, and only they log it.
                     if (self.speculative_k is not None
+                            and self.role != "decode"
                             and not self._spec_suspended_logged):
                         self._spec_suspended_logged = True
                         self._log.info(
@@ -2922,7 +3018,10 @@ class InferenceEngine:
         n = self._plan_block(active)
         use_multi = (
             n > 1
-            and self.speculative_k is None     # spec already batches
+            # (a spec engine reaching here DIDN'T speculate this step —
+            # draft miss / non-greedy — and must not also forfeit the
+            # block amortization; the fused spec round otherwise spans
+            # the same plan itself)
             # every row the block writes must land inside the cache
             and all(self.slot_len[s] + n <= self.cache_len
                     for s in active)
@@ -3047,6 +3146,10 @@ class InferenceEngine:
             round(1.0 - live / mapped_tokens, 4) if mapped_tokens else 0.0)
         snap["preemptions"] = self.preemptions
         snap["rejected_too_large"] = self.rejected_too_large
+        # satellite of ISSUE 9: with a draft model and an explicit pool
+        # budget, the draft cache's contiguous bytes were deducted from
+        # the page pool (token-equivalent) so admission can't over-admit
+        snap["draft_kv_reserved_tokens"] = self.draft_kv_reserved_tokens
         if self.prefix_cache is not None:
             snap["prefix_index_entries"] = self.prefix_cache.n_entries
         return snap

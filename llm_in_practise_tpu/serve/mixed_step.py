@@ -109,6 +109,109 @@ def batched_chunk(model, params, cache, chunk_ids, starts, lens):
     return last, cache
 
 
+def spec_verify_block(model, params, cache, tokens, base, mask, *, m):
+    """Fused speculative round: verify the K drafted tokens AND run the
+    remainder of the planned decode block, in ONE jitted dispatch
+    (ROADMAP item 4 — "verify k proposed tokens inside the n-step
+    decode dispatch").
+
+    The pre-fusion spec path cost a contiguous engine TWO dispatches
+    per round (the wide verify + a host-driven index ``_rewind``) and
+    capped every round at ``n_acc + 1`` tokens however large
+    ``decode_steps`` was. This body folds the whole round into one
+    program:
+
+    1. one wide forward over the K+1 proposed positions (index pinned
+       to the host-tracked ``base`` — the same pin idiom as
+       :func:`batched_chunk`, so idle/mid-prefill rows stop
+       accumulating index drift);
+    2. ON-DEVICE acceptance: ``n_acc`` = longest prefix of the drafts
+       matching the forward's own greedy outputs (a cumprod over the
+       matches — the host loop, vectorized);
+    3. the index fixup the separate rewind dispatch used to do:
+       ``base + (n_acc + 1) * mask`` (mask 0 rows — idle, mid-prefill
+       — are restored to ``base`` exactly);
+    4. ``m`` extra greedy scan steps from each row's bonus token
+       ``out[s, n_acc]`` — the tail of the planned n-step block, so a
+       spec round spans the same dispatch plan as a plain multi-step
+       block (``m = block - 1``, see :func:`plan_spec_extension`).
+       Each step overwrites the next rejected draft position before any
+       query can attend it (overwrite-before-attend, as everywhere).
+
+    ``tokens``: (B, K+1) — ``[last_token, draft_1..K]`` per row (zeros
+    for undrafted/idle rows). ``base``: (B,) pinned pre-dispatch cache
+    index. ``mask``: (B,) 1 for really-advancing rows. Returns
+    ``(out (B, K+1), n_acc (B,), extra (B, m), cache)`` with the final
+    index at ``base + (n_acc + 1 + m) * mask``.
+
+    Greedy-lossless: every emitted token — accepted, bonus, or
+    extension — is an argmax of this program's own forward, identical
+    to what the sequential greedy path emits.
+    """
+    base = base.astype(jnp.int32)
+    mask = mask.astype(jnp.int32)
+    logits, cache = model.apply(
+        {"params": params}, tokens, deterministic=True,
+        cache=pin_index(cache, base),
+    )
+    out = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    # longest accepted prefix: position j is accepted iff every draft
+    # up to and including j matched the model's own output
+    match = (out[:, :-1] == tokens[:, 1:]).astype(jnp.int32)   # (B, K)
+    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)        # (B,)
+    if m == 0:
+        cache = pin_index(cache, base + (n_acc + 1) * mask)
+        extra = jnp.zeros((tokens.shape[0], 0), jnp.int32)
+        return out, n_acc, extra, cache
+    # bonus token = the model's continuation at the first mismatch (or
+    # past the last draft) — the extension decodes onward from it
+    bonus = jnp.take_along_axis(out, n_acc[:, None], axis=1)[:, 0]
+    cache = pin_index(cache, base + (n_acc + 1) * mask)
+
+    def body(carry, _):
+        tok, c = carry
+        lg, c = model.apply(
+            {"params": params}, tok[:, None], deterministic=True,
+            cache=c,
+        )
+        nxt = jnp.argmax(
+            lg[:, -1, :].astype(jnp.float32), axis=-1).astype(jnp.int32)
+        return (nxt, c), nxt
+
+    (_, cache), extra = jax.lax.scan(body, (bonus, cache), None, length=m)
+    # the scan advanced EVERY row's index by m; pin the real per-row
+    # positions (masked rows return to base, same contract as the
+    # fused mixed step's ``advance``)
+    cache = pin_index(cache, base + (n_acc + 1 + m) * mask)
+    return out, n_acc, jnp.swapaxes(extra, 0, 1), cache       # (B, m)
+
+
+def plan_spec_extension(*, block: int, k: int, headroom: int) -> int:
+    """Extra greedy steps ``m`` after the K-token verify, so one fused
+    spec dispatch spans the same ``n``-step plan as a plain block
+    (``block`` from :func:`plan_decode_block`): ``m = block - 1``,
+    shrunk to ``headroom`` (= min over live rows of
+    ``cache_len - (k + 1) - position`` — every write of the widened
+    dispatch must land inside the cache) and, when shrunk by headroom,
+    quantized DOWN to a power of two. Compile-set bound (each distinct
+    ``m`` is its own compiled program): ``m`` takes values in
+    ``{decode_steps - 1}`` ∪ ``{2^j - 1}`` (a capped block from
+    :func:`plan_decode_block` is a power of two, so ``block - 1``
+    lands one below) ∪ ``{2^j}`` (headroom quantization) ∪ ``{0}`` —
+    ~2·log2(decode_steps) variants, all reachable by a warmup that
+    drives queueing/prefill caps, same order as the plain block
+    family.
+    """
+    m = block - 1
+    if m <= 0 or headroom <= 0:
+        return 0
+    if headroom < m:
+        m = headroom
+        if m > 1:
+            m = 1 << (m.bit_length() - 1)
+    return m
+
+
 def make_mixed_step(model):
     """Build the fused mixed-step function for ``model`` (jit with
     ``donate_argnums=(1,)`` and ``static_argnames=("n",)``).

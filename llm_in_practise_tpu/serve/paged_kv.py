@@ -68,6 +68,23 @@ def pages_for(n_tokens: int, page_size: int) -> int:
     return -(-int(n_tokens) // int(page_size))
 
 
+def kv_row_bytes(model, dtype) -> int:
+    """HBM bytes one KV-cache ROW (one token position, all layers)
+    costs for ``model`` — the exchange rate the engine uses to express
+    a draft model's contiguous cache in page-pool tokens, so a paged
+    engine with a draft can't over-admit against bytes the draft
+    already spent (ISSUE 9 satellite; docs/paged-kv.md)."""
+    probe = 16
+    tpl = model.init_cache(1, probe, dtype=dtype)
+    total = 0
+    for layer in tpl:
+        for key, buf in layer.items():
+            if key == "index":
+                continue
+            total += (buf.size // probe) * buf.dtype.itemsize
+    return total
+
+
 class PagePoolExhausted(RuntimeError):
     """Allocation failed with no reclaimable pages left."""
 

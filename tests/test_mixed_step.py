@@ -213,6 +213,24 @@ def test_speculative_composes_at_single_step(model_params):
     assert spec.spec_proposed > 0            # spec really ran
 
 
+def test_spec_draft_miss_keeps_multi_step_block(model_params):
+    """ISSUE 9 regression: a speculative engine whose drafter finds
+    nothing this step (no repeating structure) must still run the
+    n-step block — the old ``use_multi`` gate forced it to one-token
+    dispatches whenever ``speculative_k`` was set. Outputs stay exact
+    vs the plain multi-step engine."""
+    model, params = model_params
+    prompt = [7, 23, 41, 3, 58, 11, 30, 9, 44, 17]   # no n-grams repeat
+    sp = SamplingParams(greedy=True, max_tokens=20)
+    ref = _engine(model, params, chunked_prefill=None,
+                  decode_steps=4).generate(prompt, sp)
+    spec = _engine(model, params, chunked_prefill=None,
+                   decode_steps=4, speculative_k=3)
+    assert spec.generate(prompt, sp) == ref
+    # draft misses fell through to real blocks, not n=1 dispatches
+    assert spec.multi_blocks > 0
+
+
 def test_mixed_step_respects_cache_tail_fallback(model_params):
     """A decoder butting against the cache end makes the fused dispatch
     infeasible (its dead chunk-write window would scatter-clamp over

@@ -194,6 +194,23 @@ def test_parity_speculative_decode_steps_1(model_params):
     assert outs[0] == outs[1]
 
 
+def test_parity_speculative_multi_step(model_params):
+    """ISSUE 9: the FUSED spec round (verify + the block's remaining
+    steps in one dispatch) at decode_steps>1 emits identical tokens in
+    both layouts, and actually spans the block (>1 committed token per
+    spec dispatch)."""
+    model, params = model_params
+    prompt = [1, 2, 3, 1, 2, 3, 1, 2]
+    sp = SamplingParams(greedy=True, max_tokens=24)
+    outs = []
+    for kw in ({"kv_layout": "paged"}, {}):
+        e = _engine(model, params, speculative_k=3, decode_steps=4, **kw)
+        outs.append(e.generate(prompt, sp))
+        assert e.spec_rounds > 0
+        assert e.spec_round_tokens / e.spec_rounds > 1.0
+    assert outs[0] == outs[1]
+
+
 def test_parity_one_shot_no_chunking(model_params):
     """The batched one-shot admission path (no chunked prefill) page-
     scatters bucket rows; tokens match the contiguous insert."""

@@ -80,9 +80,31 @@ def main() -> None:
     # same-bytes A/B is tools/kv_layout_bench.py)
     kv_layout = os.environ.get("SERVE_KV_LAYOUT", "contiguous")
     kv_pool_tokens = os.environ.get("SERVE_KV_POOL_TOKENS")
+    # speculation leg (ISSUE 9 / ROADMAP item 4): SERVE_SPEC=ngram runs
+    # the prompt-lookup proposer, SERVE_SPEC=draft a SELF-speculative
+    # draft — the target's first SERVE_SPEC_DRAFT_LAYERS blocks sharing
+    # the stem/head, the tools/tpu_spec_draft_8b.py config at this
+    # model scale. Either way the fused spec round verifies the k
+    # drafts inside the decode-steps block's dispatch; the dedicated
+    # cross-leg A/B artifact is tools/spec_ladder_bench.py
+    # (BENCH_SPEC_LADDER_r07.json).
+    spec_mode = os.environ.get("SERVE_SPEC", "off")
+    if spec_mode not in ("off", "ngram", "draft"):
+        raise SystemExit(f"SERVE_SPEC must be off|ngram|draft, "
+                         f"got {spec_mode!r}")
+    spec_k = (None if spec_mode == "off"
+              else int(os.environ.get("SERVE_SPEC_K", "4")))
+    draft_model = draft_params = None
+    if spec_mode == "draft":
+        D = int(os.environ.get("SERVE_SPEC_DRAFT_LAYERS", "2"))
+        draft_params = {k: v for k, v in params.items()
+                        if not k.startswith("block_")
+                        or int(k.rsplit("_", 1)[1]) < D}
+        draft_model = GPT(cfg.replace(n_layer=D))
     engine = InferenceEngine(
         model, params, max_slots=MAX_SLOTS, cache_len=1024,
-        chunked_prefill=256, speculative_k=None,
+        chunked_prefill=256, speculative_k=spec_k,
+        draft_model=draft_model, draft_params=draft_params,
         decode_steps=decode_steps, mixed_step=mixed_step,
         kv_layout=kv_layout,
         kv_pool_tokens=(int(kv_pool_tokens) if kv_pool_tokens else None),
@@ -91,7 +113,8 @@ def main() -> None:
     tok = ByteTokenizer()
     prompt_ids = [tok.encode(p) for p in PROMPTS]
     print(f"device {jax.devices()[0].device_kind} | slots {MAX_SLOTS} | "
-          f"decode_steps {decode_steps} | mixed_step {mixed_step}",
+          f"decode_steps {decode_steps} | mixed_step {mixed_step} | "
+          f"spec {spec_mode}",
           flush=True)
 
     # warmup: compile prefill buckets (incl. the pow2 batched-admission
@@ -172,6 +195,15 @@ def main() -> None:
                    "chunked_prefill": 256,
                    "decode_steps": decode_steps,
                    "mixed_step": mixed_step,
+                   "speculation": {
+                       "mode": spec_mode, "k": spec_k,
+                       "proposed": engine.spec_proposed,
+                       "accepted": engine.spec_accepted,
+                       "spec_rounds": engine.spec_rounds,
+                       "tokens_per_spec_dispatch": (
+                           round(engine.spec_round_tokens
+                                 / engine.spec_rounds, 3)
+                           if engine.spec_rounds else None)},
                    "kv_layout": kv_layout,
                    "debug_kv": engine.debug_kv(),
                    "mixed_blocks": engine.mixed_blocks,
