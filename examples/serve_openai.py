@@ -28,6 +28,83 @@ from llm_in_practise_tpu.serve.api import OpenAIServer
 from llm_in_practise_tpu.serve.engine import InferenceEngine
 
 
+def validate_args(args, error) -> None:
+    """Flag-combination validation, split from :func:`main` so the
+    rules are unit-testable without loading a checkpoint
+    (tests/test_tp_serving.py). ``error`` is ``parser.error`` (raises
+    SystemExit with the message). Mutates ``args.speculative`` to the
+    role-resolved value.
+
+    ISSUE 10 deleted the ``--tensor-parallel-size`` fail-fasts against
+    ``--quantized_dir`` (packed leaves now shard via
+    quant/sharding.py component shardings) and ``--draft-model-path``
+    (the small draft replicates across the mesh). ``--scan-layers``
+    keeps its TP error: the stacked layout serves contiguous-only
+    (no paged pool, no per-block TP rule table) and stays the
+    single-chip flat-compile-time path.
+    """
+    if args.quantized_dir and args.lora_modules:
+        error("--lora-modules with --quantized_dir is not supported "
+              "(adapters cannot merge into packed 4-bit kernels)")
+    if args.scan_layers and args.tp > 1:
+        error("--scan-layers with --tensor-parallel-size is not "
+              "supported: the stacked scan layout is contiguous-only "
+              "(no paged pool, no stacked TP rule table — "
+              "docs/serving-tp.md 'Limitations'); serve deep models "
+              "sharded with the unrolled layout instead")
+    if args.tp_quantized_collectives and args.tp <= 1:
+        error("--tp-quantized-collectives requires "
+              "--tensor-parallel-size > 1 (there is no collective to "
+              "quantize on one chip)")
+    if args.tp_quantized_collectives and args.quantized_dir:
+        error("--tp-quantized-collectives with --quantized_dir is not "
+              "supported: packed trees run their matmuls through the "
+              "fused dequant interceptor, which the quantized-"
+              "collective interceptor does not compose with")
+    if args.scan_layers and args.lora_modules:
+        error("--lora-modules with --scan-layers is not supported: "
+              "adapters merge by unrolled block_i/... kernel paths, "
+              "which do not exist in the stacked tree (they would "
+              "silently serve base weights)")
+    if args.role != "both" and not args.kv_remote:
+        error(f"--role {args.role} requires --kv-remote: the KV handoff "
+              "between the prefill and decode pools travels through the "
+              "shared kv_pool server")
+    if args.scan_layers and args.kv_layout == "paged":
+        error("--scan-layers serves with --kv-layout contiguous only "
+              "(the paged pool supports the unrolled cache layout; "
+              "pass --kv-layout contiguous explicitly)")
+    # a draft model still needs an EXPLICIT K (checked before the
+    # decode-role default below resolves one, or the requirement would
+    # be silently bypassed on --role decode)
+    if args.draft_model_path and args.speculative is None:
+        error("--draft-model-path requires --speculative K")
+    # decode replicas default speculation ON (ISSUE 9 / ROADMAP item 4):
+    # the fused verify-inside-the-block round is the production decode
+    # path once no prefill ever shares the replica; --speculative 0
+    # opts out explicitly. Only the ngram proposer can be defaulted
+    # (the draft-model path was handled above).
+    from llm_in_practise_tpu.serve.disagg import default_speculative_k
+
+    resolved_spec = default_speculative_k(args.role, args.speculative)
+    if args.role == "decode" and args.speculative is None:
+        print(f"decode replica: ngram speculation ON by default "
+              f"(k={resolved_spec}; --speculative 0 disables)")
+    args.speculative = resolved_spec
+    if args.draft_model_path and args.speculative is None:
+        # --speculative 0 resolved the opt-out: a draft model with
+        # speculation off is contradictory — fail at the CLI, not with
+        # an engine ValueError traceback after the checkpoint loads
+        error("--draft-model-path with --speculative 0 is "
+              "contradictory: drop the draft model or pass a "
+              "positive K")
+    if args.draft_model_path and args.scan_layers:
+        error("--draft-model-path with --scan-layers is not supported "
+              "yet: the draft loads unstacked (cache slot axis 0) while "
+              "the stacked target uses axis 1 — the engine would reject "
+              "the layout mismatch after the full checkpoint restore")
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model_path", default="/tmp/qwen3_merged/model.msgpack")
@@ -154,11 +231,22 @@ def main():
                    default="float32", choices=["float32", "bfloat16", "fp8"],
                    help="KV cache storage dtype; fp8 (e4m3) halves KV HBM "
                         "vs bf16 (vLLM --kv-cache-dtype fp8 parity)")
+    p.add_argument("--tp-quantized-collectives",
+                   dest="tp_quantized_collectives", action="store_true",
+                   help="int8 activation all-reduce for the row-parallel "
+                        "TP matmuls (ZeRO++ idiom, arxiv 2306.10209): "
+                        "halves the per-token interconnect traffic. "
+                        "LOSSY opt-in — greedy tokens are checked "
+                        "against the plain path at startup and the flag "
+                        "falls back (with a warning) on mismatch "
+                        "(docs/serving-tp.md)")
     p.add_argument("--quantized_dir", default=None,
                    help="serve a packed 4-bit export from "
                         "examples/quantize_ptq.py (weights stay packed in "
                         "HBM, fused dequant matmuls — vLLM "
-                        "compressed-tensors serving parity)")
+                        "compressed-tensors serving parity; composes "
+                        "with --tensor-parallel-size via "
+                        "quant/sharding.py component shardings)")
     p.add_argument("--scan-layers", dest="scan_layers",
                    action="store_true",
                    help="serve in the scan-layers layout: params and KV "
@@ -167,64 +255,20 @@ def main():
                         "models (packed 4-bit weights ride the scan as "
                         "sideband inputs); Qwen3-family only")
     args = p.parse_args()
-
-    if args.quantized_dir and args.tp > 1:
-        p.error("--tensor-parallel-size with --quantized_dir is not "
-                "supported yet (packed leaves have no TP shardings)")
-    if args.quantized_dir and args.lora_modules:
-        p.error("--lora-modules with --quantized_dir is not supported "
-                "(adapters cannot merge into packed 4-bit kernels)")
-    if args.scan_layers and args.tp > 1:
-        p.error("--scan-layers with --tensor-parallel-size is not "
-                "supported yet (stacked paths have no TP rules)")
-    if args.scan_layers and args.lora_modules:
-        p.error("--lora-modules with --scan-layers is not supported: "
-                "adapters merge by unrolled block_i/... kernel paths, "
-                "which do not exist in the stacked tree (they would "
-                "silently serve base weights)")
-    if args.role != "both" and not args.kv_remote:
-        p.error(f"--role {args.role} requires --kv-remote: the KV handoff "
-                "between the prefill and decode pools travels through the "
-                "shared kv_pool server")
-    if args.scan_layers and args.kv_layout == "paged":
-        p.error("--scan-layers serves with --kv-layout contiguous only "
-                "(the paged pool supports the unrolled cache layout; "
-                "pass --kv-layout contiguous explicitly)")
-    # a draft model still needs an EXPLICIT K (checked before the
-    # decode-role default below resolves one, or the requirement would
-    # be silently bypassed on --role decode)
-    if args.draft_model_path and args.speculative is None:
-        p.error("--draft-model-path requires --speculative K")
-    # decode replicas default speculation ON (ISSUE 9 / ROADMAP item 4):
-    # the fused verify-inside-the-block round is the production decode
-    # path once no prefill ever shares the replica; --speculative 0
-    # opts out explicitly. Only the ngram proposer can be defaulted
-    # (the draft-model path was handled above).
-    from llm_in_practise_tpu.serve.disagg import default_speculative_k
-
-    resolved_spec = default_speculative_k(args.role, args.speculative)
-    if args.role == "decode" and args.speculative is None:
-        print(f"decode replica: ngram speculation ON by default "
-              f"(k={resolved_spec}; --speculative 0 disables)")
-    args.speculative = resolved_spec
-    if args.draft_model_path and args.speculative is None:
-        # --speculative 0 resolved the opt-out: a draft model with
-        # speculation off is contradictory — fail at the CLI, not with
-        # an engine ValueError traceback after the checkpoint loads
-        p.error("--draft-model-path with --speculative 0 is "
-                "contradictory: drop the draft model or pass a "
-                "positive K")
-    if args.draft_model_path and args.scan_layers:
-        p.error("--draft-model-path with --scan-layers is not supported "
-                "yet: the draft loads unstacked (cache slot axis 0) while "
-                "the stacked target uses axis 1 — the engine would reject "
-                "the layout mismatch after the full checkpoint restore")
-    if args.draft_model_path and args.tp > 1:
-        p.error("--draft-model-path with --tensor-parallel-size is not "
-                "supported yet: the draft params/KV would sit unsharded "
-                "on one device next to the sharded target")
+    validate_args(args, p.error)
 
     tok = BPETokenizer.load(args.tokenizer_path)
+
+    # the mesh exists BEFORE the model loads: a packed QuantizedModel
+    # needs it at construction (mesh -> the SPMD-partitionable XLA
+    # dequant path; Pallas custom calls are opaque to the partitioner)
+    mesh = None
+    if args.tp > 1:
+        from llm_in_practise_tpu.parallel import strategy as S
+
+        strat = S.tensor_parallel(model=args.tp, data=1)
+        mesh = strat.build_mesh(jax.devices()[: args.tp])
+
     if args.quantized_dir:
         from llm_in_practise_tpu.quant import io as quant_io
         from llm_in_practise_tpu.serve.quantized import QuantizedModel
@@ -236,7 +280,7 @@ def main():
             base = GPT(GPTConfig.from_dict(meta["config"]))
         else:
             base = Qwen3(Qwen3Config.from_dict(meta["config"]))
-        model = QuantizedModel(base)
+        model = QuantizedModel(base, mesh=mesh)
         print(f"packed 4-bit model: {args.quantized_dir} "
               f"({meta.get('method')}, ppl {meta.get('ppl')}) "
               f"| devices: {jax.devices()}")
@@ -265,17 +309,35 @@ def main():
         print(f"scan-layers serving: {scfg.n_layer} layers, "
               "one compiled block per engine program")
 
-    mesh = None
     shard_fn = None
     if args.tp > 1:
-        from llm_in_practise_tpu.parallel import strategy as S
         from llm_in_practise_tpu.serve.engine import shard_params_for_serving
 
-        strat = S.tensor_parallel(model=args.tp, data=1)
-        mesh = strat.build_mesh(jax.devices()[: args.tp])
+        # quant-aware (ISSUE 10): packed Int8/Int4/NF4/AWQ leaves get
+        # component shardings from the same serving rule table, so an
+        # int8 14B loads shard-parallel instead of failing fast
         shard_fn = lambda p: shard_params_for_serving(p, strat, mesh)
         params = shard_fn(params)
-        print(f"tensor parallel over {args.tp} devices")
+        print(f"tensor parallel over {args.tp} devices"
+              + (" (packed quantized tree, component shardings)"
+                 if args.quantized_dir else ""))
+        if args.role == "decode":
+            # the documented disagg fleet shape (docs/serving-tp.md):
+            # multi-chip decode replicas fed by single-chip prefill
+            print(f"fleet shape: --role decode with tp={args.tp} — "
+                  "single-chip prefill replicas feed this replica "
+                  "through the kv-pool handoff (entries reshard on "
+                  "claim)")
+    if args.tp_quantized_collectives:
+        # golden-token-checked opt-in (ZeRO++ idiom, lossy): the int8
+        # collective serves only if its greedy tokens match the plain
+        # path on the probe prompt — else warn and fall back. One gate
+        # policy, shared with tools/tp_ladder_bench.py.
+        from llm_in_practise_tpu.parallel.collectives import (
+            maybe_quantized_collectives,
+        )
+
+        model, _ = maybe_quantized_collectives(model, mesh, params)
 
     # KV is only valid under the weights that produced it, so every served
     # model (base + each adapter) gets its OWN tiered pool; the remote

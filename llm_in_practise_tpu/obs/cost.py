@@ -63,6 +63,21 @@ HBM_BW = (
 )
 FALLBACK_HBM_BW = 819e9
 
+# Aggregate inter-chip interconnect (ICI) bandwidth per chip (bytes/s,
+# datasheet link counts × per-link rate) — the divisor behind the
+# analytic `llm_collective_seconds_total` attribution for
+# tensor-parallel serving (docs/serving-tp.md). These are optimistic
+# all-links-busy numbers; a ring all-reduce uses a subset, so treat the
+# derived seconds as a LOWER bound on collective time.
+ICI_BW = (
+    ("v6 lite", 448e9), ("v6e", 448e9),
+    ("v5p", 600e9),
+    ("v5 lite", 200e9), ("v5e", 200e9),
+    ("v4", 300e9),
+    ("v3", 140e9),
+)
+FALLBACK_ICI_BW = 200e9
+
 
 def _lookup(kind: str, table, fallback: float) -> float:
     low = kind.lower()
@@ -85,6 +100,13 @@ def chip_hbm_bw(kind: str | None = None) -> float:
     if kind is None:
         kind, _ = chip_peak()
     return _lookup(kind, HBM_BW, FALLBACK_HBM_BW)
+
+
+def chip_ici_bw(kind: str | None = None) -> float:
+    """Aggregate ICI bandwidth (bytes/s per chip) for ``kind``."""
+    if kind is None:
+        kind, _ = chip_peak()
+    return _lookup(kind, ICI_BW, FALLBACK_ICI_BW)
 
 
 def device_memory_stats(device=None) -> dict:
@@ -192,6 +214,15 @@ class Geometry:
     n_layer: int
     attn_dim: int          # query width per token (n_head · head_dim)
     kv_dim: int            # KV width per cached token (n_kv_head · head_dim)
+    # residual-stream width — the payload of the row-parallel activation
+    # all-reduces under tensor parallelism (2 per layer: attention
+    # out-projection + MLP down-projection). 0 = unknown (collective
+    # attribution renders nothing).
+    hidden: int = 0
+    # vocab width — the lm_head's logits reduction is row-parallel too
+    # (rule table: P("model", "fsdp") on its in axis) and on large-vocab
+    # models it is a third of the per-token wire. 0 = unknown.
+    vocab: int = 0
 
 
 def geometry_from_config(cfg) -> Geometry | None:
@@ -216,13 +247,13 @@ def geometry_from_config(cfg) -> Geometry | None:
         inter = cfg.intermediate_size
         m = vocab * d + n_layer * (d * q + 2 * d * kv + q * d
                                    + 3 * d * inter)
-        return Geometry(m, n_layer, q, kv)
+        return Geometry(m, n_layer, q, kv, hidden=d, vocab=vocab)
     if hasattr(cfg, "embed_dim") and hasattr(cfg, "mlp_ratio"):
         # GPT-family: MHA (kv width == q width), 2-matmul MLP (in/out)
         d = cfg.embed_dim
         inter = int(cfg.mlp_ratio * d)
         m = vocab * d + n_layer * (4 * d * d + 2 * d * inter)
-        return Geometry(m, n_layer, d, d)
+        return Geometry(m, n_layer, d, d, hidden=d, vocab=vocab)
     return None
 
 
@@ -241,14 +272,24 @@ class CostModel:
     peak_flops: float
     peak_hbm_bw: float
     device_kind: str = "unknown"
+    # tensor-parallel extent of the serving mesh (the ``model`` axis):
+    # FLOPs/bytes stay GLOBAL (each chip does its shard's share), so the
+    # peaks multiply by ``tp`` and every utilization reads per-chip —
+    # the ISSUE 10 per-chip attribution convention. ``ici_bw`` divides
+    # the analytic per-chip collective wire bytes into seconds.
+    tp: int = 1
+    ici_bw: float = FALLBACK_ICI_BW
 
     @classmethod
     def from_model(cls, model, params, *, cache_dtype=None,
-                   device_kind: str | None = None) -> "CostModel | None":
+                   device_kind: str | None = None,
+                   tp: int = 1) -> "CostModel | None":
         """Derive a cost model from a live model + its (possibly
         quantized) param tree. Returns ``None`` (never raises) when the
         model family isn't covered — callers treat that as "no device
-        plane", not an error."""
+        plane", not an error. ``tp``: tensor-parallel extent of the
+        serving mesh's ``model`` axis — peaks scale by it so MFU/BW
+        utilizations attribute per chip."""
         try:
             geom = geometry_from_config(getattr(model, "config", None))
             if geom is None:
@@ -260,13 +301,17 @@ class CostModel:
                 device_kind, peak = chip_peak()
             else:
                 peak = _lookup(device_kind, PEAKS, FALLBACK_PEAK)
+            tp = max(int(tp), 1)
             return cls(
                 geometry=geom,
                 weight_bytes=tree_bytes(params),
                 kv_bytes_per_token=geom.n_layer * 2 * geom.kv_dim * itemsize,
-                peak_flops=peak,
-                peak_hbm_bw=_lookup(device_kind, HBM_BW, FALLBACK_HBM_BW),
+                peak_flops=peak * tp,
+                peak_hbm_bw=_lookup(device_kind, HBM_BW,
+                                    FALLBACK_HBM_BW) * tp,
                 device_kind=device_kind,
+                tp=tp,
+                ici_bw=_lookup(device_kind, ICI_BW, FALLBACK_ICI_BW),
             )
         except Exception:  # noqa: BLE001 — cost modeling must never be
             # able to fail engine construction
@@ -306,6 +351,39 @@ class CostModel:
         weights at serving batch sizes)."""
         return (weight_passes * self.weight_bytes
                 + self.kv_bytes_per_token * (kv_read_tokens + new_tokens))
+
+    # -- collectives (tensor parallel) ---------------------------------------
+
+    def collective_bytes(self, new_tokens: float,
+                         quantized: bool = False) -> float:
+        """Per-chip ICI wire bytes of one forward's row-parallel
+        all-reduces over ``new_tokens`` positions: 2 per layer
+        (attention out-projection + MLP down-projection, ``hidden``
+        elements each) PLUS the lm_head's logits reduction (``vocab``
+        elements — a third of the per-token wire on large-vocab
+        models), all at ring-all-reduce traffic ``2·(tp-1)/tp`` per
+        chip. Activations are priced at bf16 (2 bytes); the int8
+        quantized collective (``--tp-quantized-collectives``,
+        parallel/collectives.py) halves the LAYER part — the lm_head
+        reduction is deliberately never quantized (argmax fragility),
+        so its bytes stay bf16. Returns 0 at tp=1 or unknown
+        geometry."""
+        if self.tp <= 1 or self.geometry.hidden <= 0:
+            return 0.0
+        layer_elems = 2.0 * self.geometry.n_layer * self.geometry.hidden \
+            * new_tokens
+        head_elems = float(self.geometry.vocab) * new_tokens
+        per_elem = 1.0 if quantized else 2.0
+        return 2.0 * (self.tp - 1) / self.tp * (
+            layer_elems * per_elem + head_elems * 2.0)
+
+    def collective_seconds(self, nbytes: float) -> float:
+        """Analytic LOWER-bound seconds those wire bytes cost at the
+        chip's aggregate ICI bandwidth (docs/observability.md states
+        the caveat — XLA overlaps collectives with compute)."""
+        if nbytes <= 0 or self.ici_bw <= 0:
+            return 0.0
+        return nbytes / self.ici_bw
 
     # -- utilizations --------------------------------------------------------
 

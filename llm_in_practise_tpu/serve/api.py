@@ -601,6 +601,25 @@ class OpenAIServer:
         reg.gauge_func("llm_device_hbm_bytes", _hbm,
                        "device memory from device.memory_stats(): "
                        "bytes in use / peak / limit")
+        # tensor-parallel plane (docs/serving-tp.md): the mesh extent
+        # and the analytic per-chip collective attribution — wire bytes
+        # of the row-parallel activation all-reduces and the
+        # lower-bound seconds they cost at datasheet ICI bandwidth.
+        # Registered unconditionally (zeros at tp=1) so dashboards and
+        # the metric-docs census see one stable family set.
+        reg.gauge_func("llm_tp_size", lambda: eng.tp,
+                       "tensor-parallel extent of the serving mesh's "
+                       "model axis (1 = single chip)")
+        reg.counter_func("llm_collective_bytes_total",
+                         lambda: eng.collective_bytes_total,
+                         "analytic per-chip ICI wire bytes of the "
+                         "row-parallel activation all-reduces "
+                         "(halved under --tp-quantized-collectives)")
+        reg.counter_func("llm_collective_seconds_total",
+                         lambda: eng.collective_seconds_total,
+                         "analytic lower-bound seconds those bytes "
+                         "cost at datasheet ICI bandwidth (XLA "
+                         "overlaps collectives with compute)")
         # SLO goodput (obs/meter.py GoodputMeter): tokens priced by
         # whether their request met the TTFT/TPOT SLOs; zero until
         # thresholds are configured (engine ttft_slo_s/tpot_slo_s)

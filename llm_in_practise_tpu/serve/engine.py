@@ -284,7 +284,11 @@ class InferenceEngine:
         # :func:`shard_params_for_serving`; the KV cache shards its heads
         # dim over the mesh's ``model`` axis and XLA compiles the
         # activation collectives into the same decode/prefill programs.
+        # ``tp`` (the model-axis extent) scales the device plane's peaks
+        # so MFU/BW utilizations attribute PER CHIP, and prices the
+        # per-dispatch activation collectives (docs/serving-tp.md).
         self.mesh = mesh
+        self.tp = int(mesh.shape.get("model", 1)) if mesh is not None else 1
         self.max_slots = max_slots
         limit = max_positions(getattr(model, "config", None))
         self.cache_len = min(cache_len, limit) if limit else cache_len
@@ -512,6 +516,25 @@ class InferenceEngine:
                     "dispatch on the serving thread)")
             self.draft_cache = draft_model.init_cache(
                 max_slots, self.cache_len, dtype=cache_dtype)
+            if mesh is not None:
+                # TP serving (ISSUE 10 satellite): the draft is small —
+                # REPLICATE its params and KV cache across the mesh
+                # instead of sharding, so the draft roll/catch-up
+                # programs run without collectives and their outputs
+                # feed the sharded target's verify without resharding.
+                # (An unplaced draft tree would sit committed on device
+                # 0 and conflict with the mesh-placed target inside the
+                # same jitted dispatch.)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(mesh, PartitionSpec())
+                self.draft_params = draft_params = jax.device_put(
+                    draft_params,
+                    jax.tree_util.tree_map(lambda _: rep, draft_params))
+                self.draft_cache = jax.device_put(
+                    self.draft_cache,
+                    jax.tree_util.tree_map(lambda _: rep,
+                                           self.draft_cache))
             dax = int(getattr(draft_model, "cache_slot_axis", 0))
             if dax != self._sax:
                 raise ValueError(
@@ -623,8 +646,29 @@ class InferenceEngine:
         # per dispatch → live per-phase MFU / HBM-bandwidth-utilization
         # gauges. Fail-open None for model families the analytic
         # geometry doesn't cover (the gauges just don't render).
+        # Under TP the peaks scale by the mesh's model extent so the
+        # utilizations attribute per chip (ISSUE 10 satellite).
         self.cost_model = CostModel.from_model(model, params,
-                                               cache_dtype=cache_dtype)
+                                               cache_dtype=cache_dtype,
+                                               tp=self.tp)
+        # tensor-parallel collective attribution (docs/serving-tp.md):
+        # per-chip ICI wire bytes of each dispatch's row-parallel
+        # activation all-reduces (analytic — cost model), and the
+        # lower-bound seconds they cost at datasheet ICI bandwidth.
+        # Engine-thread writes, scrape-thread reads of monotone floats
+        # (the single-writer convention of the spec_* counters). Both
+        # stay 0.0 at tp=1, so the /metrics families render zeros there.
+        self.collective_bytes_total = 0.0
+        self.collective_seconds_total = 0.0
+        # int8 quantized collectives (parallel/collectives.py): the
+        # model facade carries the behavior; the engine only needs the
+        # flag to halve the wire-byte attribution
+        from llm_in_practise_tpu.parallel.collectives import (
+            TPQuantizedCollectives,
+        )
+
+        self.tp_quantized_collectives = isinstance(
+            model, TPQuantizedCollectives)
 
         # Dispatch accounting: every jitted engine program is wrapped so
         # /metrics (llm_dispatches_*) and the mixed-step tests can assert
@@ -1540,6 +1584,15 @@ class InferenceEngine:
             mfu = cm.mfu(cm.step_flops(tokens, attended_keys), dt)
             bw = cm.hbm_util(
                 cm.step_bytes(weight_passes, kv_read_tokens, tokens), dt)
+        if cm is not None and self.tp > 1:
+            # TP collective attribution: every forward position pays
+            # the row-parallel activation all-reduces — analytic
+            # per-chip wire bytes + lower-bound ICI seconds
+            # (llm_collective_{bytes,seconds}_total)
+            cb = cm.collective_bytes(
+                tokens, quantized=self.tp_quantized_collectives)
+            self.collective_bytes_total += cb
+            self.collective_seconds_total += cm.collective_seconds(cb)
         self.dispatch_meter.note_phase(phase, tokens=tokens, duration_s=dt,
                                        mfu=mfu, hbm_bw_util=bw)
 
@@ -3180,5 +3233,22 @@ class InferenceEngine:
 def shard_params_for_serving(params, strategy, mesh):
     """Place model params for sharded serving (TP/FSDP over ``mesh``) —
     the loading step vLLM does per tensor-parallel rank, here one
-    device_put against the strategy's NamedShardings."""
+    device_put against the strategy's NamedShardings.
+
+    Packed quantized trees (Int8/Int4/NF4/AWQ leaves from
+    ``quant/io.load_packed``) are detected and placed through
+    :func:`~llm_in_practise_tpu.quant.sharding.quant_tree_shardings`
+    with the SAME strategy rule table — each component array of a
+    packed leaf gets the sharding the bf16 weight would have, respecting
+    the format's internal blocking (ISSUE 10: int8 14B loads
+    shard-parallel instead of failing fast at the CLI)."""
+    from llm_in_practise_tpu.quant.sharding import (
+        QUANT_LEAVES,
+        shard_quant_tree,
+    )
+
+    is_quant = lambda x: isinstance(x, QUANT_LEAVES)  # noqa: E731
+    if any(is_quant(leaf) for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=is_quant)):
+        return shard_quant_tree(params, mesh, strategy.effective_rules())
     return jax.device_put(params, strategy.param_shardings(params, mesh))

@@ -103,6 +103,28 @@ def multiprocess_reason() -> str:
 
 
 @functools.lru_cache(maxsize=None)
+def host_device_count() -> int:
+    """How many devices the backend exposes — the tensor-parallel
+    serving suite needs >= 4 (the conftest forces
+    ``--xla_force_host_platform_device_count=8`` virtual CPU devices;
+    a bare env without the flag, or a 1-chip TPU host, re-arms the
+    skips automatically)."""
+    import jax
+
+    try:
+        return len(jax.devices())
+    except Exception:
+        return 0
+
+
+def tp_devices_reason(need: int) -> str:
+    return (f"tensor-parallel serving tests need >= {need} devices; "
+            f"this backend exposes {host_device_count()} (set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8 with "
+            f"JAX_PLATFORMS=cpu, as tests/conftest.py does)")
+
+
+@functools.lru_cache(maxsize=None)
 def has_pinned_host_memory() -> bool:
     """ZeRO-offload places optimizer state in the ``pinned_host``
     memory space; the CPU backend only exposes ``unpinned_host``."""

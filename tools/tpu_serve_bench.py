@@ -92,6 +92,23 @@ def main() -> None:
     if spec_mode not in ("off", "ngram", "draft"):
         raise SystemExit(f"SERVE_SPEC must be off|ngram|draft, "
                          f"got {spec_mode!r}")
+    # tensor-parallel leg (ISSUE 10 / ROADMAP item 1): SERVE_TP=N
+    # shards the model + KV cache over the first N devices — decode is
+    # bandwidth-bound (perf.md Findings 13/14), so each layer shard
+    # streams from its own HBM controller and the per-token weight-read
+    # floor divides by N. On the real 8-chip host this is the
+    # production decode-replica shape (docs/serving-tp.md); the
+    # CPU-reproducible correctness ladder is tools/tp_ladder_bench.py.
+    serve_tp = int(os.environ.get("SERVE_TP", "1"))
+    mesh = None
+    if serve_tp > 1:
+        if serve_tp > len(jax.devices()):
+            raise SystemExit(f"SERVE_TP={serve_tp} but only "
+                             f"{len(jax.devices())} devices attached")
+        from llm_in_practise_tpu.parallel import strategy as S
+
+        _strat = S.tensor_parallel(model=serve_tp, data=1)
+        mesh = _strat.build_mesh(jax.devices()[:serve_tp])
     spec_k = (None if spec_mode == "off"
               else int(os.environ.get("SERVE_SPEC_K", "4")))
     draft_model = draft_params = None
@@ -101,12 +118,18 @@ def main() -> None:
                         if not k.startswith("block_")
                         or int(k.rsplit("_", 1)[1]) < D}
         draft_model = GPT(cfg.replace(n_layer=D))
+    if mesh is not None:
+        from llm_in_practise_tpu.serve.engine import (
+            shard_params_for_serving,
+        )
+
+        params = shard_params_for_serving(params, _strat, mesh)
     engine = InferenceEngine(
         model, params, max_slots=MAX_SLOTS, cache_len=1024,
         chunked_prefill=256, speculative_k=spec_k,
         draft_model=draft_model, draft_params=draft_params,
         decode_steps=decode_steps, mixed_step=mixed_step,
-        kv_layout=kv_layout,
+        kv_layout=kv_layout, mesh=mesh,
         kv_pool_tokens=(int(kv_pool_tokens) if kv_pool_tokens else None),
     )
     engine.start()
@@ -114,7 +137,7 @@ def main() -> None:
     prompt_ids = [tok.encode(p) for p in PROMPTS]
     print(f"device {jax.devices()[0].device_kind} | slots {MAX_SLOTS} | "
           f"decode_steps {decode_steps} | mixed_step {mixed_step} | "
-          f"spec {spec_mode}",
+          f"spec {spec_mode} | tp {serve_tp}",
           flush=True)
 
     # warmup: compile prefill buckets (incl. the pow2 batched-admission
@@ -205,6 +228,12 @@ def main() -> None:
                                  / engine.spec_rounds, 3)
                            if engine.spec_rounds else None)},
                    "kv_layout": kv_layout,
+                   "tensor_parallel": {
+                       "tp": serve_tp,
+                       "collective_bytes_total":
+                           round(engine.collective_bytes_total, 1),
+                       "collective_seconds_total":
+                           round(engine.collective_seconds_total, 6)},
                    "debug_kv": engine.debug_kv(),
                    "mixed_blocks": engine.mixed_blocks,
                    "dispatches_per_step":
