@@ -778,6 +778,30 @@ def obs_snapshot(server=None, engine=None) -> dict:
         snap["device_plane"] = device_plane_snapshot(engine)
     except Exception as e:  # noqa: BLE001 — same artifact-assembly rule
         snap["device_plane_error"] = f"{type(e).__name__}: {e}"
+    try:
+        snap["host_gap"] = host_gap_snapshot(engine)
+    except Exception as e:  # noqa: BLE001 — same artifact-assembly rule
+        snap["host_gap_error"] = f"{type(e).__name__}: {e}"
+    return snap
+
+
+def host_gap_snapshot(engine=None) -> dict | None:
+    """The host-gap block of a bench artifact (obs/steptrace.py): the
+    per-activity host-second totals, the rolling device-busy / host-gap
+    fractions, and the coverage check — attributed host activities plus
+    device dispatch time over engine-loop wall time, the quantity the
+    serve benches gate at >= 0.95. This is the baseline ROADMAP item
+    3's host/device-overlap refactor must drive toward zero host gap."""
+    stp = getattr(engine, "steptrace", None)
+    if stp is None:
+        return None
+    snap = dict(stp.snapshot())
+    snap["host_seconds"] = {k: round(v, 6)
+                            for k, v in snap["host_seconds"].items()}
+    for k in ("step_wall_seconds_total", "device_seconds_total",
+              "device_busy_fraction", "host_gap_fraction", "coverage"):
+        snap[k] = round(snap[k], 6)
+    snap["coverage_ok"] = snap["coverage"] >= 0.95
     return snap
 
 

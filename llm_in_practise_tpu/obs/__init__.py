@@ -41,6 +41,10 @@ from llm_in_practise_tpu.obs.prof import (  # noqa: F401
     ProfilerCapture,
     get_profiler,
 )
+from llm_in_practise_tpu.obs.steptrace import (  # noqa: F401
+    ACTIVITIES as STEPTRACE_ACTIVITIES,
+    StepTrace,
+)
 from llm_in_practise_tpu.obs.registry import (  # noqa: F401
     Counter,
     Gauge,

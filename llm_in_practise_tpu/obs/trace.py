@@ -247,7 +247,9 @@ class Tracer:
         # authoritative check runs under the lock below
         if self._file is None:  # graftlint: disable=guarded-by
             return
-        line = json.dumps(_chrome_event(span)) + "\n"
+        self._write_line(json.dumps(_chrome_event(span)) + "\n")
+
+    def _write_line(self, line: str) -> None:
         with self._file_lock:
             if self._file is None:
                 return
@@ -272,6 +274,29 @@ class Tracer:
                     pass
                 self._file = None
                 self._file_path = None
+
+    @property
+    def has_file_sink(self) -> bool:
+        """True while a Chrome-JSONL sink is open (racy read — callers
+        use it to skip work, the write path rechecks under the lock)."""
+        return self._file is not None  # graftlint: disable=guarded-by
+
+    @property
+    def file_sink_path(self) -> str | None:
+        """Current sink path, None when closed (racy read, same
+        contract as ``has_file_sink``) — lets the steptrace dual-lane
+        export re-emit its lane metadata after a sink rotation."""
+        return self._file_path  # graftlint: disable=guarded-by
+
+    def write_event(self, event: dict) -> None:
+        """Write one raw Chrome trace event to the JSONL sink only — no
+        ring entry. The steptrace dual-lane timeline
+        (:mod:`llm_in_practise_tpu.obs.steptrace`) rides here: per-step
+        host/device lane slices would evict real request spans if they
+        went through the bounded ring."""
+        if self._file is None:  # graftlint: disable=guarded-by
+            return
+        self._write_line(json.dumps(event) + "\n")
 
     # -- consumption ----------------------------------------------------------
 

@@ -469,12 +469,36 @@ class OpenAIServer:
                             "api.stream_flush", span,
                             duration_s=flush_s,
                             chunks=n_chunks)
+                        exc = sys.exc_info()[1]
+                        # critical-path: the stream tail joins the
+                        # request's /debug/requests breakdown and the
+                        # aggregate counter. Per-request cp is written
+                        # ONLY on a clean stream end: the generator then
+                        # saw _FINISH, which the engine releases after
+                        # its last cp write (_record_finished), so this
+                        # thread owns the dict. A disconnect
+                        # (GeneratorExit) mid-decode would race the
+                        # engine's writers — skip cp there (the debug
+                        # view documents stream_flush as possibly
+                        # absent) and book the aggregate only, which
+                        # goes through note_stream_flush ONLY —
+                        # _record_finished skips this segment. The
+                        # write is still a dict SWAP, not an insert:
+                        # /debug/requests readers may be iterating the
+                        # old object.
+                        if exc is None:
+                            handle.cp = {
+                                **handle.cp,
+                                "stream_flush":
+                                    handle.cp.get("stream_flush", 0.0)
+                                    + flush_s,
+                            }
+                        engine.stats.note_stream_flush(flush_s)
                         # headers already went out as 200, but the span
                         # must say how the stream actually ended: a mid-
                         # flight engine death surfaces as an in-band
                         # error event, a client disconnect as
                         # GeneratorExit — neither is a clean "stop"
-                        exc = sys.exc_info()[1]
                         if exc is None:
                             span.end(status=200,
                                      finish_reason=handle.finish_reason
@@ -634,13 +658,69 @@ class OpenAIServer:
         # (was: full-history summaries) — PromQL quantiles come from
         # histogram_quantile() over the _bucket series.
         role_labels = {} if self.role == "both" else {"role": self.role}
-        reg.histogram_func("llm_ttft_seconds",
-                           lambda: [(role_labels, s.ttft)],
+
+        # warm-vs-cold TTFT attribution (ISSUE 11 satellite): the plain
+        # series stays (dashboards/tests key on it); the cache-labeled
+        # children split the SAME observations by the prefix-/handoff-
+        # hit outcome at admission, so the warm-vs-cold win (perf.md
+        # Finding 16's 1783→176 ms pair) is a live PromQL ratio
+        def _ttft():
+            out = [(role_labels, s.ttft)]
+            out.extend(({**role_labels, "cache": k}, acc)
+                       for k, acc in sorted(s.ttft_by_cache.items()))
+            return out
+
+        reg.histogram_func("llm_ttft_seconds", _ttft,
                            "time to first token (prefill replicas: "
-                           "KV-claimable time)")
+                           "KV-claimable time); cache-labeled children "
+                           "split by admission prefix/handoff outcome")
         reg.histogram_func("llm_tpot_seconds",
                            lambda: [(role_labels, s.tpot)],
                            "mean time per output token after the first")
+        # host-gap plane (obs/steptrace.py, ISSUE 11): the per-step
+        # engine-loop timeline — where the host spends the time between
+        # dispatches, and the live device-busy/host-gap dial the
+        # ROADMAP item-3 overlap refactor must move. All reads go
+        # through the recorder's atomically swapped snapshot (single-
+        # writer convention; a scrape never mixes two steps' totals).
+        stp = eng.steptrace
+
+        def _host_gap():
+            snap = stp.snapshot()
+            return [({"activity": a}, v)
+                    for a, v in sorted(snap["host_seconds"].items())]
+
+        reg.counter_func("llm_host_gap_seconds_total", _host_gap,
+                         "engine-thread seconds between dispatches, by "
+                         "host activity (queue_drain/admit/plan/"
+                         "index_build/draft_propose/dispatch_wait/"
+                         "sample_commit/publish/other)")
+        reg.counter_func(
+            "llm_step_wall_seconds_total",
+            lambda: stp.snapshot()["step_wall_seconds_total"],
+            "cumulative engine step() wall seconds (non-idle steps)")
+        reg.counter_func(
+            "llm_engine_steps_total",
+            lambda: stp.snapshot()["steps"],
+            "non-idle engine step() iterations recorded")
+        reg.gauge_func(
+            "llm_device_busy_fraction",
+            lambda: stp.snapshot()["device_busy_fraction"],
+            "rolling fraction of step wall time the device was busy "
+            "(forced dispatch windows / step wall, last 50 steps)")
+        reg.gauge_func(
+            "llm_host_gap_fraction",
+            lambda: stp.snapshot()["host_gap_fraction"],
+            "rolling fraction of step wall time the chip waited on "
+            "Python (1 - device_busy; the item-3 overlap target)")
+        # per-request critical-path aggregate: every finished request's
+        # wall time decomposed into segments (GET /debug/requests has
+        # the per-request view)
+        reg.counter_func(
+            "llm_request_critical_path_seconds_total",
+            lambda: [({"segment": seg}, v) for seg, v in
+                     sorted(s.critical_path_snapshot().items())],
+            "finished requests' wall seconds by critical-path segment")
         # disaggregation accounting: published/claimed say the handoff
         # plane works; lost + local re-prefills say how often the decode
         # pool fell back to doing prefill itself (the llm-d health signal)
@@ -793,6 +873,12 @@ class OpenAIServer:
                         # / block-table sizes (docs/paged-kv.md); the
                         # contiguous layout reports its reservation
                         return self._json(200, server.engine.debug_kv())
+                    if self.path == "/debug/requests":
+                        # recent-finished ring with per-request
+                        # critical-path breakdowns (ISSUE 11; see
+                        # docs/observability.md "Host timeline")
+                        return self._json(
+                            200, server.engine.debug_requests())
                     if self.path == "/v1/models":
                         return self._json(200, {
                             "object": "list",

@@ -36,6 +36,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import jax
@@ -49,6 +50,7 @@ from llm_in_practise_tpu.obs.logging import get_logger
 from llm_in_practise_tpu.obs.meter import DispatchMeter, GoodputMeter
 from llm_in_practise_tpu.obs.prof import CompileMeter
 from llm_in_practise_tpu.obs.registry import HistogramAccumulator
+from llm_in_practise_tpu.obs.steptrace import StepTrace
 from llm_in_practise_tpu.obs.trace import get_tracer
 from llm_in_practise_tpu.serve.mixed_step import (
     batched_chunk,
@@ -73,6 +75,24 @@ class SamplingParams:
 
 
 _FINISH = object()  # sentinel closing a request's token queue
+
+# Per-request critical-path segments (ISSUE 11): every finished
+# request's wall time decomposes into these bins — surfaced per request
+# at GET /debug/requests and aggregated into
+# llm_request_critical_path_seconds_total{segment=…}. ``host_gap`` is
+# the residual none of the attributed segments claim (the
+# between-dispatch host time the steptrace recorder measures per step);
+# ``stream_flush`` is the API-side SSE write tail, measured on the
+# handler thread CONCURRENTLY with decode, so it is reported alongside
+# the engine segments but excluded from the wall-clock partition.
+CP_SEGMENTS = ("queue_wait", "admission", "prefill_dispatch",
+               "decode_dispatch", "host_gap", "handoff_wire",
+               "preempt_recompute", "stream_flush")
+# re-admission after a page-pool preemption re-pays these segments; the
+# re-pay is charged to preempt_recompute so a preempted request's
+# breakdown says "recompute", not "a second mysterious prefill"
+_CP_RECOMPUTE_SEGS = frozenset(
+    ("queue_wait", "admission", "prefill_dispatch"))
 
 
 class EngineDeadError(RuntimeError):
@@ -126,6 +146,41 @@ class Request:
     # nothing.
     trace: object | None = dataclasses.field(
         default=None, repr=False, compare=False)
+    # critical-path breakdown (GET /debug/requests): per-segment
+    # seconds of this request's wall clock, accumulated where the
+    # engine knows them (see CP_SEGMENTS). Writers are phase-exclusive
+    # — the HTTP thread at submit, the engine thread while slotted, the
+    # publisher thread at publish, the API thread after the stream
+    # closes — so no lock is needed.
+    cp: dict = dataclasses.field(default_factory=dict, repr=False,
+                                 compare=False)
+    # warm-vs-cold TTFT attribution: the prefix-/handoff-hit outcome at
+    # FIRST admission ("hit" | "partial" | "cold"); labels the
+    # llm_ttft_seconds histogram with cache=…
+    cache_outcome: str | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    # stamped by the paged preempt path so the re-admission's queue
+    # wait is charged to preempt_recompute, not queue_wait
+    requeue_time: float | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    # origin of the NEXT queue_wait interval: re-armed at every queue
+    # pop and re-stamped by preempt, so a request requeued N times
+    # (admit-blocked on a dry page pool, or preempted) books N disjoint
+    # wait intervals instead of N overlapping ones from submit_time
+    cp_queue_origin: float | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def cp_add(self, seg: str, dt: float) -> None:
+        """Accumulate ``dt`` seconds into critical-path segment ``seg``.
+        Once a request has been preempted, the re-paid admission
+        segments redirect into ``preempt_recompute``. Keyed on
+        ``requeue_time`` (stamped by every preempt) rather than
+        ``resume_last``: a MID-PREFILL preempt emitted nothing, so it
+        has no resume token, but its second prefill is recompute all
+        the same."""
+        if self.requeue_time is not None and seg in _CP_RECOMPUTE_SEGS:
+            seg = "preempt_recompute"
+        self.cp[seg] = self.cp.get(seg, 0.0) + float(dt)
 
     def next_item(self, poll_s: float = 1.0):
         """Next queue item — a token id or the internal finish sentinel
@@ -186,11 +241,35 @@ class EngineStats:
         self.queue_depth = 0            # guarded-by: lock
         self.active_slots = 0           # guarded-by: lock
         self.requests_shed = 0          # guarded-by: lock
+        # warm-vs-cold TTFT attribution (ISSUE 11 satellite / ROADMAP
+        # item 1's metric ask): the same TTFT observations, split by the
+        # prefix-/handoff-hit outcome at admission — rendered as
+        # llm_ttft_seconds{cache="hit"|"partial"|"cold"} next to the
+        # plain series, so "is the cache working fleet-wide" is one
+        # PromQL ratio instead of a bench run
+        self.ttft_by_cache = {k: HistogramAccumulator()
+                              for k in ("hit", "partial", "cold")}
+        # per-segment request critical-path aggregate
+        # (llm_request_critical_path_seconds_total{segment=…}); written
+        # from the engine thread (finish) AND the publisher/API threads
+        # (handoff, stream flush), hence under the lock
+        self.critical_path = {seg: 0.0 for seg in CP_SEGMENTS}  # guarded-by: lock
         # SLO goodput (obs/meter.py): inactive until thresholds are
         # configured (engine ttft_slo_s/tpot_slo_s kwargs, or the serve
         # benches post-warmup) — then every finished request's tokens
         # land in llm_goodput_tokens_total{slo=ok|violated}
         self.goodput = GoodputMeter()
+
+    def note_stream_flush(self, dt: float) -> None:
+        """Book a stream's SSE write tail (API handler thread) into the
+        critical-path aggregate — it arrives after the engine finished
+        the request, so it cannot ride ``observe_finished``."""
+        with self.lock:
+            self.critical_path["stream_flush"] += float(dt)
+
+    def critical_path_snapshot(self) -> dict:
+        with self.lock:
+            return dict(self.critical_path)
 
     def observe_finished(self, req: Request):
         with self.lock:
@@ -200,6 +279,8 @@ class EngineStats:
         # against the engine thread's finish path
         if req.ttft_s is not None:
             self.ttft.observe(req.ttft_s)
+            acc = self.ttft_by_cache.get(req.cache_outcome or "cold")
+            (acc or self.ttft_by_cache["cold"]).observe(req.ttft_s)
         if req.tpot_s is not None:
             self.tpot.observe(req.tpot_s)
         if self.goodput.enabled and req.finish_reason != "queue_full":
@@ -259,6 +340,7 @@ class InferenceEngine:
         kv_layout: str = "contiguous",
         kv_page_size: int = 16,
         kv_pool_tokens: int | None = None,
+        steptrace: StepTrace | None = None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -585,6 +667,18 @@ class InferenceEngine:
         # TraceContext; the process default keeps a single-process stack
         # (tests, chip sharing) on one correlated trace plane
         self.tracer = tracer if tracer is not None else get_tracer()
+        # host-gap flight recorder (obs/steptrace.py, ISSUE 11): one
+        # record per step(), partitioning the step's wall clock into
+        # named host activities + device-busy time. Engine-thread
+        # writer; /metrics reads its swapped snapshot.
+        # LLM_TPU_STEPTRACE=off disables (tests pin golden-token
+        # parity either way).
+        self.steptrace = steptrace if steptrace is not None else StepTrace()
+        # recent finished requests for GET /debug/requests — each
+        # carries its critical-path breakdown (Request.cp). deque
+        # append/iteration are GIL-atomic; HTTP readers snapshot with
+        # list() (same contract as the slot_prefill .get reads).
+        self.finished: deque = deque(maxlen=128)
         self._spec_suspended_logged = False
         self._mixed_fallbacks_logged: set[str] = set()
         # Guaranteed chunked-prefill budget: every engine step runs up to
@@ -1373,6 +1467,12 @@ class InferenceEngine:
             # for this request (its target KV is being recomputed)
             self._draft_uid[slot] = -1
         self.preemptions += 1
+        # the re-admission's wait + recompute are charged to the
+        # preempt_recompute critical-path segment from this stamp on;
+        # the queue-wait origin moves here too (the slotted time just
+        # spent is already booked to its dispatch segments)
+        req.requeue_time = time.monotonic()
+        req.cp_queue_origin = req.requeue_time
         with self.pending.mutex:
             self.pending.queue.appendleft(req)
         self._log.info(
@@ -1496,6 +1596,7 @@ class InferenceEngine:
         layer / gateway) turns that into 429 + retry-elsewhere."""
         req.finish_time = time.monotonic()
         req.finish_reason = "queue_full"
+        self._record_finished(req)
         req.tokens.put(_FINISH)
         with self.stats.lock:
             self.stats.requests_shed += 1
@@ -1530,12 +1631,18 @@ class InferenceEngine:
                 self.stats.requests_total += 1
             req.finish_time = time.monotonic()
             req.finish_reason = "too_large"
+            self._record_finished(req)
             req.tokens.put(_FINISH)
             return req
         # the upload must land on the request BEFORE it is queued — the
         # engine thread may admit it the instant the put releases
         if kv_entry is not None:
+            t0 = time.monotonic()
             req.kv_entry = self._accept_external_kv(kv_entry, prompt_ids)
+            # validate + device upload of the claimed entry — the
+            # decode-side half of the handoff wire cost (the kv-pool
+            # server cross-checks with kvpool_handoff_wire_seconds)
+            req.cp_add("handoff_wire", time.monotonic() - t0)
         with self.stats.lock:
             self.stats.requests_total += 1
         with self._submit_lock:
@@ -1569,6 +1676,64 @@ class InferenceEngine:
         self.tracer.record(name, req.trace, duration_s=duration_s,
                            uid=req.uid, **attrs)
 
+    @staticmethod
+    def _note_cache_outcome(req: Request, hit, plen: int) -> None:
+        """Label the request's warm-vs-cold TTFT outcome from the
+        prefix-/handoff-hit the admission path resolved. First admission
+        wins: a preempt-resume re-admission page-hits its OWN registered
+        pages and must not relabel a cold request as warm."""
+        if req.cache_outcome is not None or req.resume_last is not None:
+            return
+        if hit is None:
+            req.cache_outcome = "cold"
+        elif getattr(hit, "length", 0) >= plen:
+            req.cache_outcome = "hit"
+        else:
+            req.cache_outcome = "partial"
+
+    @staticmethod
+    def _cp_pf_spent(req: Request) -> float:
+        """Prefill-attributed critical-path seconds booked so far —
+        the admission segment is the admit wall MINUS what the inner
+        prefill dispatches already claimed."""
+        return (req.cp.get("prefill_dispatch", 0.0)
+                + req.cp.get("preempt_recompute", 0.0))
+
+    def _cp_admission(self, req: Request, dt: float, pre: float) -> None:
+        req.cp_add("admission",
+                   max(0.0, dt - (self._cp_pf_spent(req) - pre)))
+
+    def _record_finished(self, req: Request) -> None:
+        """Finalize the request's critical-path breakdown and remember
+        it for ``GET /debug/requests``. ``host_gap`` is the residual
+        wall time no attributed segment claims — exactly the
+        between-dispatch host time the steptrace recorder measures per
+        step, here per request. Runs on whichever thread finishes the
+        request (engine, publisher, HTTP shed path)."""
+        wall = (req.finish_time or time.monotonic()) - req.submit_time
+        if req.finish_reason == "queue_full" and not req.cp:
+            # a shed spent its whole life waiting; say so
+            req.cp["queue_wait"] = wall
+        attributed = sum(v for k, v in req.cp.items()
+                         if k != "stream_flush")
+        req.cp["host_gap"] = max(0.0, wall - attributed)
+        with self.stats.lock:
+            cp = self.stats.critical_path
+            for seg, dt in req.cp.items():
+                # stream_flush aggregates through note_stream_flush on
+                # the handler thread — the ONLY aggregate writer for
+                # that segment; summing it here too would double-book a
+                # stream that closed before the engine finished (client
+                # disconnect mid-decode)
+                if seg in cp and seg != "stream_flush":
+                    cp[seg] += dt
+        # the ring must not pin KV: a shed request can still hold the
+        # device/host entry uploaded at submit() (admission, which
+        # nulls it, never ran) — 128 retained multi-MB buffers under
+        # sustained overload is an OOM, not a debug view
+        req.kv_entry = None
+        self.finished.append(req)
+
     def _note_device_phase(self, phase: str, *, tokens: int,
                            attended_keys: float, weight_passes: float,
                            kv_read_tokens: float, dt: float) -> None:
@@ -1578,6 +1743,10 @@ class InferenceEngine:
         cost model only tokens-per-dispatch is recorded. Draft-model
         dispatches are not booked (the cost model covers the target
         model; the draft's work would inflate both utilizations)."""
+        # host-gap recorder: the forced dispatch window is device-busy
+        # time; it is deducted from the surrounding host activity so the
+        # step partition never double-counts this wall clock
+        self.steptrace.note_device(dt, phase)
         cm = self.cost_model
         mfu = bw = None
         if cm is not None and dt > 0:
@@ -1613,19 +1782,20 @@ class InferenceEngine:
             # the deadline, not after burning a full queue wait. FIFO
             # order means staleness is monotone from the head.
             now = time.monotonic()
-            while True:
-                with self.pending.mutex:
-                    head = (self.pending.queue[0]
-                            if self.pending.queue else None)
-                    if (head is None
-                            or head.resume_last is not None
-                            or now - head.submit_time <= timeout_s):
-                        # preempted-resume requests are exempt: their
-                        # stream already started, so a deadline shed
-                        # would truncate a live response
-                        break
-                    self.pending.queue.popleft()
-                self._shed(head)
+            with self.steptrace.scope("queue_drain"):
+                while True:
+                    with self.pending.mutex:
+                        head = (self.pending.queue[0]
+                                if self.pending.queue else None)
+                        if (head is None
+                                or head.resume_last is not None
+                                or now - head.submit_time <= timeout_s):
+                            # preempted-resume requests are exempt: their
+                            # stream already started, so a deadline shed
+                            # would truncate a live response
+                            break
+                        self.pending.queue.popleft()
+                    self._shed(head)
         batch: list[tuple[int, Request, int]] = []
         deferred: list[tuple[int, Request, int]] = []
         seen: set[tuple[int, ...]] = set()
@@ -1638,20 +1808,21 @@ class InferenceEngine:
                 # reservation (and double-count admission telemetry)
                 break
             req = None
-            while req is None:
-                try:
-                    req = self.pending.get_nowait()
-                except queue.Empty:
-                    break
-                if (timeout_s is not None
-                        and req.resume_last is None
-                        and time.monotonic() - req.submit_time
-                        > timeout_s):
-                    # waited past the deadline: the client is better
-                    # served by a fast 429 it can retry elsewhere than
-                    # by a TTFT already worse than any SLA
-                    self._shed(req)
-                    req = None
+            with self.steptrace.scope("queue_drain"):
+                while req is None:
+                    try:
+                        req = self.pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    if (timeout_s is not None
+                            and req.resume_last is None
+                            and time.monotonic() - req.submit_time
+                            > timeout_s):
+                        # waited past the deadline: the client is better
+                        # served by a fast 429 it can retry elsewhere
+                        # than by a TTFT already worse than any SLA
+                        self._shed(req)
+                        req = None
             if req is None:
                 break
             # queue wait = submit → a slot freed for it; under sustained
@@ -1659,6 +1830,19 @@ class InferenceEngine:
             self._trace_phase(req, "engine.queue_wait",
                               time.monotonic() - req.submit_time,
                               slot=slot)
+            cp_base = req.cp_queue_origin
+            if cp_base is None:
+                # first pop: a claimed-KV upload at submit() runs
+                # BEFORE queueing and is already booked to
+                # handoff_wire — shift the origin so queue_wait
+                # doesn't re-claim that window (the segments must
+                # partition, not overlap)
+                cp_base = req.submit_time + req.cp.get("handoff_wire", 0.0)
+            req.cp_add("queue_wait",
+                       max(0.0, time.monotonic() - cp_base))
+            # re-arm: if admission blocks (dry page pool) and requeues
+            # this request, the next pop books only [here, next pop]
+            req.cp_queue_origin = time.monotonic()
             plen = len(req.prompt_ids)
             hit = self._lookup_prefix(req, plen)
             if (self.role == "decode"
@@ -1687,35 +1871,44 @@ class InferenceEngine:
                     # the batch stores its prefix entry this becomes a
                     # full-prefix hit — keep the sequential path's
                     # intra-burst reuse instead of prefilling it again
+                    # (its cache label comes from that later lookup)
                     deferred.append((slot, req, plen))
                 else:
                     if cacheable:
                         seen.add(tuple(req.prompt_ids))
+                    self._note_cache_outcome(req, None, plen)
                     batch.append((slot, req, plen))
             else:
                 t0 = time.monotonic()
                 path = ("kv_direct_insert"
                         if hit is not None and hit.length == plen
                         else "prefill")
+                pre = self._cp_pf_spent(req)
                 self._begin_prefill(req, slot, plen, hit=hit)
-                self._trace_phase(req, "engine.admit",
-                                  time.monotonic() - t0, slot=slot,
+                dt = time.monotonic() - t0
+                self._trace_phase(req, "engine.admit", dt, slot=slot,
                                   path=path, prompt_tokens=plen)
+                self._cp_admission(req, dt, pre)
             admitted = True
         if batch:
             t0 = time.monotonic()
+            pre = {req.uid: self._cp_pf_spent(req) for _, req, _ in batch}
             self._prefill_batch(batch)
             dt = time.monotonic() - t0
             for slot, req, plen in batch:
                 self._trace_phase(req, "engine.admit", dt, slot=slot,
                                   path="oneshot_batch", prompt_tokens=plen,
                                   batched=len(batch))
+                self._cp_admission(req, dt, pre[req.uid])
         for slot, req, plen in deferred:
             t0 = time.monotonic()
+            pre = self._cp_pf_spent(req)
             self._begin_prefill(req, slot, plen)  # fresh lookup: now a hit
-            self._trace_phase(req, "engine.admit", time.monotonic() - t0,
+            dt = time.monotonic() - t0
+            self._trace_phase(req, "engine.admit", dt,
                               slot=slot, path="deferred_prefix_hit",
                               prompt_tokens=plen)
+            self._cp_admission(req, dt, pre)
         with self.stats.lock:
             self.stats.queue_depth = self.pending.qsize()
             self.stats.active_slots = sum(r is not None for r in self.slot_req)
@@ -1761,73 +1954,85 @@ class InferenceEngine:
                 size = 1 << ((len(group) - i).bit_length() - 1)
                 part = group[i:i + size]
                 i += size
-                ids = np.zeros((size, bucket), np.int32)
-                lens = np.zeros((size,), np.int32)
-                for j, (_, req, plen) in enumerate(part):
-                    ids[j, :plen] = req.prompt_ids
-                    lens[j] = plen
-                t0 = time.monotonic()
-                last, pre = self._prefill(
-                    self.params, jnp.asarray(ids), jnp.asarray(lens))
-                if self.paged is not None:
-                    sidx = self.paged.rows_scatter_idx(
-                        [p[0] for p in part], [p[2] for p in part],
-                        bucket)
-                    self.paged.kv = self._pg_write_rows(
-                        self.paged.kv, pre, jnp.asarray(sidx))
-                else:
-                    slot_ids = np.array([p[0] for p in part], np.int32)
-                    self.cache = self._insert_batch(
-                        self.cache, pre, jnp.asarray(slot_ids),
-                        jnp.asarray(lens))
-                self.rng, sub = jax.random.split(self.rng)
-                first = np.asarray(sample_token_batched(
-                    sub, last.astype(jnp.float32),
-                    temperature=jnp.asarray(
-                        [r.params.temperature for _, r, _ in part],
-                        jnp.float32),
-                    top_k=jnp.asarray(
-                        [r.params.top_k for _, r, _ in part], jnp.int32),
-                    top_p=jnp.asarray(
-                        [r.params.top_p for _, r, _ in part], jnp.float32),
-                    greedy=jnp.asarray(
-                        [r.params.greedy for _, r, _ in part], bool),
-                ))
-                # device plane: useful (un-padded) tokens only, so
-                # bucket padding shows up as lost MFU — which it is.
-                # (dt is honest: np.asarray above forced the chain.)
-                keys = sum(CostModel.chunk_keys(p, 0)
-                           for _, _, p in part)
-                self._note_device_phase(
-                    "prefill",
-                    tokens=sum(p for _, _, p in part),
-                    attended_keys=keys,
-                    weight_passes=1, kv_read_tokens=keys,
-                    dt=time.monotonic() - t0)
-                for j, (slot, req, plen) in enumerate(part):
+                with self.steptrace.scope("index_build"):
+                    ids = np.zeros((size, bucket), np.int32)
+                    lens = np.zeros((size,), np.int32)
+                    for j, (_, req, plen) in enumerate(part):
+                        ids[j, :plen] = req.prompt_ids
+                        lens[j] = plen
+                with self.steptrace.scope("dispatch_wait"):
+                    t0 = time.monotonic()
+                    last, pre = self._prefill(
+                        self.params, jnp.asarray(ids), jnp.asarray(lens))
                     if self.paged is not None:
-                        # rows are in pages now — register them instead
-                        # of slicing copies (handoff gathers page-wise)
-                        row_slices = None
-                        self._paged_store_prefix(req, plen, slot,
-                                                 last[j:j + 1])
+                        sidx = self.paged.rows_scatter_idx(
+                            [p[0] for p in part], [p[2] for p in part],
+                            bucket)
+                        self.paged.kv = self._pg_write_rows(
+                            self.paged.kv, pre, jnp.asarray(sidx))
                     else:
-                        sl = ((slice(None),) * self._sax
-                              + (slice(j, j + 1),))
-                        row_slices = [{k: v[sl] for k, v in layer.items()
-                                       if k != "index"} for layer in pre]
-                        self._store_prefix(req, plen, row_slices,
-                                           last[j:j + 1])
-                    if req.handoff_id is not None:
-                        # the group's bucket IS _bucket_for(plen), so
-                        # these rows are already handoff-width — skip
-                        # the redundant _slot_rows gather
-                        self._complete_handoff(slot, req, plen,
-                                               last[j:j + 1],
-                                               rows=row_slices)
-                    else:
-                        self._activate_with_token(slot, req, plen,
-                                                  int(first[j]))
+                        slot_ids = np.array([p[0] for p in part],
+                                            np.int32)
+                        self.cache = self._insert_batch(
+                            self.cache, pre, jnp.asarray(slot_ids),
+                            jnp.asarray(lens))
+                    self.rng, sub = jax.random.split(self.rng)
+                    first = np.asarray(sample_token_batched(
+                        sub, last.astype(jnp.float32),
+                        temperature=jnp.asarray(
+                            [r.params.temperature for _, r, _ in part],
+                            jnp.float32),
+                        top_k=jnp.asarray(
+                            [r.params.top_k for _, r, _ in part],
+                            jnp.int32),
+                        top_p=jnp.asarray(
+                            [r.params.top_p for _, r, _ in part],
+                            jnp.float32),
+                        greedy=jnp.asarray(
+                            [r.params.greedy for _, r, _ in part], bool),
+                    ))
+                    # device plane: useful (un-padded) tokens only, so
+                    # bucket padding shows up as lost MFU — which it is.
+                    # (dt is honest: np.asarray above forced the chain.)
+                    keys = sum(CostModel.chunk_keys(p, 0)
+                               for _, _, p in part)
+                    dt = time.monotonic() - t0
+                    self._note_device_phase(
+                        "prefill",
+                        tokens=sum(p for _, _, p in part),
+                        attended_keys=keys,
+                        weight_passes=1, kv_read_tokens=keys,
+                        dt=dt)
+                for _, req, _ in part:
+                    # every member waited the whole batched dispatch
+                    req.cp_add("prefill_dispatch", dt)
+                with self.steptrace.scope("sample_commit"):
+                    for j, (slot, req, plen) in enumerate(part):
+                        if self.paged is not None:
+                            # rows are in pages now — register them
+                            # instead of slicing copies (handoff
+                            # gathers page-wise)
+                            row_slices = None
+                            self._paged_store_prefix(req, plen, slot,
+                                                     last[j:j + 1])
+                        else:
+                            sl = ((slice(None),) * self._sax
+                                  + (slice(j, j + 1),))
+                            row_slices = [
+                                {k: v[sl] for k, v in layer.items()
+                                 if k != "index"} for layer in pre]
+                            self._store_prefix(req, plen, row_slices,
+                                               last[j:j + 1])
+                        if req.handoff_id is not None:
+                            # the group's bucket IS _bucket_for(plen),
+                            # so these rows are already handoff-width —
+                            # skip the redundant _slot_rows gather
+                            self._complete_handoff(slot, req, plen,
+                                                   last[j:j + 1],
+                                                   rows=row_slices)
+                        else:
+                            self._activate_with_token(slot, req, plen,
+                                                      int(first[j]))
 
     def _complete_handoff(self, slot: int, req: Request, plen: int,
                           last_logits, rows=None) -> None:
@@ -1845,37 +2050,41 @@ class InferenceEngine:
         index-free row dicts already sliced from the prefill cache."""
         from llm_in_practise_tpu.serve import prefix_cache as pc
 
-        if self.paged is not None:
-            # page-wise handoff: the entry spans ceil(plen/P)*P rows —
-            # only live pages ship over the wire, not a pow2 bucket (a
-            # 200-token prompt is 13 16-row pages = 208 rows, where the
-            # bucket path shipped 256). The gather COPIES the page rows
-            # into fresh buffers, so the slot's pages free right here.
-            entry = self._paged_gather_entry(slot, plen, last_logits)
-            self.paged.release_slot(slot)
-        else:
-            bucket = self._bucket_for(plen)
-            if rows is None:
-                rows = self._slot_rows(self.cache,
-                                       jnp.asarray(slot, jnp.int32),
-                                       bucket=bucket)
-            # _slot_rows / the batch slices COPY the rows into fresh
-            # buffers, so the entry is independent of the slot, which
-            # frees right here
-            entry = pc.PrefixEntry(length=plen, bucket=bucket, rows=rows,
-                                   last_logits=last_logits,
-                                   slot_axis=self._sax)
-        self.slot_req[slot] = None
-        self.slot_ready[slot] = False
-        self.slot_budget[slot] = 0
-        self.slot_hist[slot] = None
-        if not self._publishers:
-            self._publishers = [
-                threading.Thread(target=self._run_publisher, daemon=True)
-                for _ in range(self._n_publishers)]
-            for t in self._publishers:
-                t.start()
-        self._publish_queue.put((req, plen, entry))
+        with self.steptrace.scope("publish"):
+            if self.paged is not None:
+                # page-wise handoff: the entry spans ceil(plen/P)*P rows
+                # — only live pages ship over the wire, not a pow2
+                # bucket (a 200-token prompt is 13 16-row pages = 208
+                # rows, where the bucket path shipped 256). The gather
+                # COPIES the page rows into fresh buffers, so the
+                # slot's pages free right here.
+                entry = self._paged_gather_entry(slot, plen, last_logits)
+                self.paged.release_slot(slot)
+            else:
+                bucket = self._bucket_for(plen)
+                if rows is None:
+                    rows = self._slot_rows(self.cache,
+                                           jnp.asarray(slot, jnp.int32),
+                                           bucket=bucket)
+                # _slot_rows / the batch slices COPY the rows into fresh
+                # buffers, so the entry is independent of the slot,
+                # which frees right here
+                entry = pc.PrefixEntry(length=plen, bucket=bucket,
+                                       rows=rows,
+                                       last_logits=last_logits,
+                                       slot_axis=self._sax)
+            self.slot_req[slot] = None
+            self.slot_ready[slot] = False
+            self.slot_budget[slot] = 0
+            self.slot_hist[slot] = None
+            if not self._publishers:
+                self._publishers = [
+                    threading.Thread(target=self._run_publisher,
+                                     daemon=True)
+                    for _ in range(self._n_publishers)]
+                for t in self._publishers:
+                    t.start()
+            self._publish_queue.put((req, plen, entry))
 
     def _run_publisher(self) -> None:
         """Handoff publisher loop: device→host copy + store put, off the
@@ -1912,10 +2121,12 @@ class InferenceEngine:
                               handoff_id=req.handoff_id,
                               prompt_tokens=plen,
                               ok=req.finish_reason == "handoff")
+            req.cp_add("handoff_wire", time.monotonic() - t0)
             req.finish_time = time.monotonic()
             # KV-claimable time is this request's TTFT analog: per-role
             # llm_ttft_seconds on a prefill replica = prefill service
             req.first_token_time = req.finish_time
+            self._record_finished(req)
             req.tokens.put(_FINISH)
             self.stats.observe_finished(req)
 
@@ -2134,6 +2345,7 @@ class InferenceEngine:
         further admission this step (decode-side growth may preempt;
         admission never does)."""
         P = self.paged.page_size
+        self._note_cache_outcome(req, hit, plen)
         if hit is not None and hit.pages is not None:
             # a page hit whose suffix neither chunks nor fits a one-shot
             # bucket inside cache_len shrinks page by page first (the
@@ -2184,46 +2396,52 @@ class InferenceEngine:
                                        "done": done, "last_logits": None}
             return
         last_logits = self._paged_suffix(slot, req.prompt_ids[done:],
-                                         done)
+                                         done, req=req)
         # store the finished prompt like every other completion path:
         # register its pages for sharing + tier write-through (the
         # contiguous twin does this in _finish_prefill)
         self._paged_store_prefix(req, plen, slot, last_logits)
         self._activate(slot, req, plen, last_logits)
 
-    def _paged_suffix(self, slot: int, suffix, done: int):
+    def _paged_suffix(self, slot: int, suffix, done: int, req=None):
         """One-shot prefill of ``suffix`` into ``slot`` at ``done``
         through the paged chunk program (the dedicated contiguous
         ``_prefill_suffix`` program has no paged twin — the chunk body
         is the same pinned-index math). Returns the last-position
-        logits row."""
+        logits row. ``req``: books the dispatch into the request's
+        critical-path breakdown when given."""
         C = self._bucket_for(len(suffix))
-        tok = np.zeros((self.max_slots, C), np.int32)
-        tok[slot, :len(suffix)] = suffix
-        W = self._paged_width(done + C)
-        starts = self._paged_index_vec(W, C)
-        starts[slot] = done
-        lens = np.zeros((self.max_slots,), np.int32)
-        lens[slot] = len(suffix)
-        valid = np.zeros((self.max_slots,), np.int32)
-        valid[slot] = len(suffix)
-        self._paged_cow_fork(slot, done, len(suffix))
-        sidx = self.paged.scatter_idx(starts, valid, C)
-        gidx = self.paged.gather_idx(W)
-        t0 = time.monotonic()
-        last, self.paged.kv = self._pg_chunk(
-            self.params, self.paged.kv, jnp.asarray(gidx),
-            jnp.asarray(tok), jnp.asarray(starts), jnp.asarray(lens),
-            jnp.asarray(sidx))
-        out = last[slot:slot + 1]
-        # force + stamp dt exactly like _prefill_into_slot (the logits
-        # feed the first-token sample on this same call path anyway)
-        jax.block_until_ready(out)
-        keys = CostModel.chunk_keys(len(suffix), done)
-        self._note_device_phase(
-            "prefill", tokens=len(suffix), attended_keys=keys,
-            weight_passes=1, kv_read_tokens=keys,
-            dt=time.monotonic() - t0)
+        with self.steptrace.scope("index_build"):
+            tok = np.zeros((self.max_slots, C), np.int32)
+            tok[slot, :len(suffix)] = suffix
+            W = self._paged_width(done + C)
+            starts = self._paged_index_vec(W, C)
+            starts[slot] = done
+            lens = np.zeros((self.max_slots,), np.int32)
+            lens[slot] = len(suffix)
+            valid = np.zeros((self.max_slots,), np.int32)
+            valid[slot] = len(suffix)
+            self._paged_cow_fork(slot, done, len(suffix))
+            sidx = self.paged.scatter_idx(starts, valid, C)
+            gidx = self.paged.gather_idx(W)
+        with self.steptrace.scope("dispatch_wait"):
+            t0 = time.monotonic()
+            last, self.paged.kv = self._pg_chunk(
+                self.params, self.paged.kv, jnp.asarray(gidx),
+                jnp.asarray(tok), jnp.asarray(starts), jnp.asarray(lens),
+                jnp.asarray(sidx))
+            out = last[slot:slot + 1]
+            # force + stamp dt exactly like _prefill_into_slot (the
+            # logits feed the first-token sample on this same call path
+            # anyway)
+            jax.block_until_ready(out)
+            dt = time.monotonic() - t0
+            keys = CostModel.chunk_keys(len(suffix), done)
+            self._note_device_phase(
+                "prefill", tokens=len(suffix), attended_keys=keys,
+                weight_passes=1, kv_read_tokens=keys, dt=dt)
+        if req is not None:
+            req.cp_add("prefill_dispatch", dt)
         return out
 
     _UNSET = object()
@@ -2240,6 +2458,7 @@ class InferenceEngine:
             return self._paged_begin_prefill(req, slot, plen, hit)
         if hit is self._UNSET:
             hit = self._lookup_prefix(req, plen)
+        self._note_cache_outcome(req, hit, plen)
         if hit is not None and hit.length == plen:
             self.cache = self._insert_rows(
                 self.cache, hit.rows, slot, jnp.asarray(plen, jnp.int32))
@@ -2288,12 +2507,13 @@ class InferenceEngine:
             # token) before the slot entered slot_prefill, so every
             # chunk write is already covered; only decode GROWTH
             # allocates on demand (_paged_reserve_active)
-            entries = []
-            for slot in sorted(self.slot_prefill):
-                st = self.slot_prefill[slot]
-                chunk = st["req"].prompt_ids[
-                    st["done"]: st["done"] + self.chunked_prefill]
-                entries.append((slot, st, chunk))
+            with self.steptrace.scope("index_build"):
+                entries = []
+                for slot in sorted(self.slot_prefill):
+                    st = self.slot_prefill[slot]
+                    chunk = st["req"].prompt_ids[
+                        st["done"]: st["done"] + self.chunked_prefill]
+                    entries.append((slot, st, chunk))
             C = self.chunked_prefill
             # whole-cache batching needs every row's C-wide write window
             # inside cache_len — a clamped scatter on a near-full ACTIVE
@@ -2314,46 +2534,49 @@ class InferenceEngine:
             pf_tokens = sum(len(c) for _, _, c in entries)
             pf_keys = sum(CostModel.chunk_keys(len(c), st["done"])
                           for _, st, c in entries)
-            t0 = time.monotonic()
-            if self.paged is not None:
-                self._paged_chunk_dispatch(entries)
-            elif batchable:
-                tok, starts, lens = self._chunk_batch_rows(entries)
-                last, self.cache = self._chunk_batch(
-                    self.params, self.cache, jnp.asarray(tok),
-                    jnp.asarray(starts), jnp.asarray(lens))
-                for slot, st, chunk in entries:
-                    st["last_logits"] = last[slot:slot + 1]
-                    st["done"] += len(chunk)
-            else:
-                for slot, st, chunk in entries:
-                    padded = np.zeros((1, C), np.int32)
-                    padded[0, :len(chunk)] = chunk
-                    st["last_logits"], self.cache = self._chunk_slot(
-                        self.params, self.cache, jnp.asarray(padded),
-                        jnp.asarray(slot, jnp.int32),
-                        jnp.asarray(st["done"], jnp.int32),
-                        jnp.asarray(len(chunk), jnp.int32),
-                    )
-                    st["done"] += len(chunk)
-            # force the chunks' last-logits before stamping dt: on an
-            # async backend issue time alone would inflate the prefill
-            # MFU/BW gauges ~device-time/dispatch-time-fold (the decode
-            # and fused paths force every dispatch the same way). The
-            # logits are consumed at activation regardless; KV writes
-            # land in the same program, so this waits only for work the
-            # next chunk depends on anyway.
-            jax.block_until_ready([st["last_logits"]
-                                   for _, st, _ in entries])
-            dt = time.monotonic() - t0
-            self._trace_chunks(entries, dt, batched=batchable)
-            self._note_device_phase(
-                "prefill", tokens=pf_tokens, attended_keys=pf_keys,
-                weight_passes=1 if batchable else len(entries),
-                kv_read_tokens=pf_keys, dt=dt)
+            with self.steptrace.scope("dispatch_wait"):
+                t0 = time.monotonic()
+                if self.paged is not None:
+                    self._paged_chunk_dispatch(entries)
+                elif batchable:
+                    tok, starts, lens = self._chunk_batch_rows(entries)
+                    last, self.cache = self._chunk_batch(
+                        self.params, self.cache, jnp.asarray(tok),
+                        jnp.asarray(starts), jnp.asarray(lens))
+                    for slot, st, chunk in entries:
+                        st["last_logits"] = last[slot:slot + 1]
+                        st["done"] += len(chunk)
+                else:
+                    for slot, st, chunk in entries:
+                        padded = np.zeros((1, C), np.int32)
+                        padded[0, :len(chunk)] = chunk
+                        st["last_logits"], self.cache = self._chunk_slot(
+                            self.params, self.cache, jnp.asarray(padded),
+                            jnp.asarray(slot, jnp.int32),
+                            jnp.asarray(st["done"], jnp.int32),
+                            jnp.asarray(len(chunk), jnp.int32),
+                        )
+                        st["done"] += len(chunk)
+                # force the chunks' last-logits before stamping dt: on
+                # an async backend issue time alone would inflate the
+                # prefill MFU/BW gauges ~device-time/dispatch-time-fold
+                # (the decode and fused paths force every dispatch the
+                # same way). The logits are consumed at activation
+                # regardless; KV writes land in the same program, so
+                # this waits only for work the next chunk depends on
+                # anyway.
+                jax.block_until_ready([st["last_logits"]
+                                       for _, st, _ in entries])
+                dt = time.monotonic() - t0
+                self._trace_chunks(entries, dt, batched=batchable)
+                self._note_device_phase(
+                    "prefill", tokens=pf_tokens, attended_keys=pf_keys,
+                    weight_passes=1 if batchable else len(entries),
+                    kv_read_tokens=pf_keys, dt=dt)
             budget -= 1
             progressed = True
-            self._finalize_prefills()
+            with self.steptrace.scope("sample_commit"):
+                self._finalize_prefills()
         return progressed
 
     def _trace_chunks(self, entries, dt: float, *, batched: bool,
@@ -2366,6 +2589,8 @@ class InferenceEngine:
                               slot=slot, done=st["done"],
                               chunk_tokens=len(chunk), batched=batched,
                               fused=fused)
+            # every mid-prefill request waited the whole chunk dispatch
+            st["req"].cp_add("prefill_dispatch", dt)
 
     def _chunk_batch_rows(self, entries):
         """Host arrays (tok, starts, lens) for a whole-cache batched
@@ -2499,6 +2724,10 @@ class InferenceEngine:
     def _prefill_into_slot(self, req: Request, slot: int, plen: int, hit):
         """One-shot prefill (reusing any cached prefix rows) into ``slot``;
         returns the last-position logits."""
+        with self.steptrace.scope("dispatch_wait"):
+            return self._prefill_into_slot_timed(req, slot, plen, hit)
+
+    def _prefill_into_slot_timed(self, req, slot, plen, hit):
         t0 = time.monotonic()
         if hit is not None:
             suffix = req.prompt_ids[hit.length:]
@@ -2525,11 +2754,12 @@ class InferenceEngine:
         # _advance_prefills); the logits feed the first-token sample on
         # this same call path anyway
         jax.block_until_ready(last_logits)
+        dt = time.monotonic() - t0
         keys = CostModel.chunk_keys(new, start)
         self._note_device_phase(
             "prefill", tokens=new, attended_keys=keys,
-            weight_passes=1, kv_read_tokens=keys,
-            dt=time.monotonic() - t0)
+            weight_passes=1, kv_read_tokens=keys, dt=dt)
+        req.cp_add("prefill_dispatch", dt)
         self._finish_prefill(req, slot, plen, pre_cache, last_logits)
         return last_logits
 
@@ -2558,6 +2788,10 @@ class InferenceEngine:
             if hist:
                 self._paged_register_pages(hist[:-1], slot)
             self.paged.release_slot(slot)
+        # breakdown finalized BEFORE _FINISH is released: a consumer
+        # that saw the stream end must find the request in the
+        # /debug/requests ring (same ordering rule as the decode span)
+        self._record_finished(req)
         req.tokens.put(_FINISH)
         self.stats.observe_finished(req)
         self.slot_req[slot] = None
@@ -2642,28 +2876,34 @@ class InferenceEngine:
         Returns False when the spec path doesn't apply this step
         (caller falls back to plain decode)."""
         k = self.speculative_k
-        if not self._spec_applicable(active):
+        with self.steptrace.scope("plan"):
+            applicable = self._spec_applicable(active)
+            if applicable:
+                # the extension m rides the SAME token-budget plan as a
+                # plain block (soonest-finish cap under queueing, chunk
+                # caps while prefilling): one fused dispatch spans
+                # verify + m greedy steps, so acceptance-count is part
+                # of the dispatch plan and the compile set stays
+                # pow2-bounded
+                m = plan_spec_extension(
+                    block=self._plan_block(active), k=k,
+                    headroom=self._spec_headroom(active))
+        if not applicable:
             return False
-        # the extension m rides the SAME token-budget plan as a plain
-        # block (soonest-finish cap under queueing, chunk caps while
-        # prefilling): one fused dispatch spans verify + m greedy
-        # steps, so acceptance-count is part of the dispatch plan and
-        # the compile set stays pow2-bounded
-        m = plan_spec_extension(block=self._plan_block(active), k=k,
-                                headroom=self._spec_headroom(active))
         # draft BEFORE touching the page pool: drafting needs no pool
         # pages (ngram is host-side; the draft model's cache is its own
         # contiguous buffer), so a draft-miss step returns to the plain
         # path without having preempted or cache-finished anybody for a
         # k+1+m reservation that would never be used
-        if self.draft_model is not None:
-            drafts = self._draft_model_propose(active, k)
-        else:
-            drafts = {}
-            for s in active:
-                d = self._draft(self.slot_hist[s], k)
-                if d is not None:
-                    drafts[s] = d             # un-padded, 1..k tokens
+        with self.steptrace.scope("draft_propose"):
+            if self.draft_model is not None:
+                drafts = self._draft_model_propose(active, k)
+            else:
+                drafts = {}
+                for s in active:
+                    d = self._draft(self.slot_hist[s], k)
+                    if d is not None:
+                        drafts[s] = d         # un-padded, 1..k tokens
         if not drafts:
             return False                      # nothing to verify; plain step
         if self.paged is not None:
@@ -2671,77 +2911,89 @@ class InferenceEngine:
             # pages up front (preempting youngest slots if dry) — the
             # speculative watermark of any preempted slot is reset in
             # _paged_preempt, so a recycled draft cache re-syncs
-            active = self._paged_reserve_active(active, k + 1 + m)
+            with self.steptrace.scope("admit"):
+                active = self._paged_reserve_active(active, k + 1 + m)
             if not active:
                 return True
             drafts = {s: d for s, d in drafts.items() if s in active}
-        tokens = np.zeros((self.max_slots, k + 1), np.int32)
-        tokens[:, 0] = self.slot_last_token
-        for s, d in drafts.items():
-            tokens[s, 1: 1 + len(d)] = d
-        mask = np.zeros((self.max_slots,), np.int32)
-        mask[active] = 1
-        t0 = time.monotonic()
-        if self.paged is not None:
-            W = self._paged_width(
-                max(int(self.slot_len[s]) for s in active) + k + 1 + m)
-            idxv = self._paged_index_vec(W, k + 1 + m)
-            valid = np.zeros((self.max_slots,), np.int32)
-            for s in active:
-                valid[s] = k + 1 + m
-                self._paged_cow_fork(s, int(self.slot_len[s]), k + 1 + m)
-            out, n_acc, extra, self.paged.kv = self._pg_spec(
-                self.params, self.paged.kv,
-                jnp.asarray(self.paged.gather_idx(W)),
-                jnp.asarray(idxv),
-                jnp.asarray(self.paged.scatter_idx(idxv, valid,
-                                                   k + 1 + m)),
-                jnp.asarray(tokens), jnp.asarray(mask), m=m)
-        else:
-            # per-row pinned index: the slot-state → index convention
-            # lives in ONE place (_paged_index_vec reads only host slot
-            # state — nothing paged about it); here the "view" is the
-            # whole contiguous cache, so W = cache_len. Free rows' dead
-            # k+1+m write window is clamped inside the cache; live rows
-            # already fit (_spec_applicable + the headroom cap on m),
-            # so their clamp is a no-op.
-            base = self._paged_index_vec(self.cache_len, k + 1 + m)
-            out, n_acc, extra, self.cache = self._decode_spec(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(base), jnp.asarray(mask), m=m)
-        out_host = np.asarray(out)
-        acc_host = np.asarray(n_acc)
-        extra_host = np.asarray(extra)
-        # the verify is ONE wide forward over k+1 positions per slot
-        # plus m single-token extension passes (that width amortizing
-        # the weight read is the whole spec bet — the decode MFU gauge
-        # shows it paying off or not). Useful positions only: an
-        # undrafted/short-draft slot's zero padding is wasted work and
-        # must read as lost MFU, same convention as the
-        # spec_proposed/spec_accepted counters below.
-        useful = {s: len(drafts.get(s, ())) + 1 + m for s in active}
-        keys = sum(CostModel.block_keys(useful[s], int(self.slot_len[s]))
-                   for s in active)
-        self._note_device_phase(
-            "decode", tokens=sum(useful.values()), attended_keys=keys,
-            weight_passes=1 + m, kv_read_tokens=keys,
-            dt=time.monotonic() - t0)
+        with self.steptrace.scope("index_build"):
+            tokens = np.zeros((self.max_slots, k + 1), np.int32)
+            tokens[:, 0] = self.slot_last_token
+            for s, d in drafts.items():
+                tokens[s, 1: 1 + len(d)] = d
+            mask = np.zeros((self.max_slots,), np.int32)
+            mask[active] = 1
+        with self.steptrace.scope("dispatch_wait"):
+            t0 = time.monotonic()
+            if self.paged is not None:
+                W = self._paged_width(
+                    max(int(self.slot_len[s]) for s in active)
+                    + k + 1 + m)
+                idxv = self._paged_index_vec(W, k + 1 + m)
+                valid = np.zeros((self.max_slots,), np.int32)
+                for s in active:
+                    valid[s] = k + 1 + m
+                    self._paged_cow_fork(s, int(self.slot_len[s]),
+                                         k + 1 + m)
+                out, n_acc, extra, self.paged.kv = self._pg_spec(
+                    self.params, self.paged.kv,
+                    jnp.asarray(self.paged.gather_idx(W)),
+                    jnp.asarray(idxv),
+                    jnp.asarray(self.paged.scatter_idx(idxv, valid,
+                                                       k + 1 + m)),
+                    jnp.asarray(tokens), jnp.asarray(mask), m=m)
+            else:
+                # per-row pinned index: the slot-state → index
+                # convention lives in ONE place (_paged_index_vec reads
+                # only host slot state — nothing paged about it); here
+                # the "view" is the whole contiguous cache, so
+                # W = cache_len. Free rows' dead k+1+m write window is
+                # clamped inside the cache; live rows already fit
+                # (_spec_applicable + the headroom cap on m), so their
+                # clamp is a no-op.
+                base = self._paged_index_vec(self.cache_len, k + 1 + m)
+                out, n_acc, extra, self.cache = self._decode_spec(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(base), jnp.asarray(mask), m=m)
+            out_host = np.asarray(out)
+            acc_host = np.asarray(n_acc)
+            extra_host = np.asarray(extra)
+            # the verify is ONE wide forward over k+1 positions per slot
+            # plus m single-token extension passes (that width
+            # amortizing the weight read is the whole spec bet — the
+            # decode MFU gauge shows it paying off or not). Useful
+            # positions only: an undrafted/short-draft slot's zero
+            # padding is wasted work and must read as lost MFU, same
+            # convention as the spec_proposed/spec_accepted counters
+            # below.
+            useful = {s: len(drafts.get(s, ())) + 1 + m for s in active}
+            keys = sum(CostModel.block_keys(useful[s],
+                                            int(self.slot_len[s]))
+                       for s in active)
+            dt = time.monotonic() - t0
+            self._note_device_phase(
+                "decode", tokens=sum(useful.values()),
+                attended_keys=keys, weight_passes=1 + m,
+                kv_read_tokens=keys, dt=dt)
         self.spec_rounds += 1
-        for s in active:
-            n_acc_s = int(acc_host[s])
-            # metrics over real drafted positions only — zero padding
-            # (and undrafted slots' zero fill) must not inflate either
-            # counter
-            n_drafted = len(drafts.get(s, ()))
-            self.spec_proposed += n_drafted
-            self.spec_accepted += min(n_acc_s, n_drafted)
-            burst = [int(out_host[s, j]) for j in range(n_acc_s + 1)]
-            burst += [int(extra_host[s, j]) for j in range(m)]
-            for tok in burst:
-                if self.slot_req[s] is None:
-                    break                     # finished mid-burst (eos/len)
-                self._commit_token(s, tok)
-                self.spec_round_tokens += 1
+        with self.steptrace.scope("sample_commit"):
+            for s in active:
+                self.slot_req[s].cp_add("decode_dispatch", dt)
+            for s in active:
+                n_acc_s = int(acc_host[s])
+                # metrics over real drafted positions only — zero
+                # padding (and undrafted slots' zero fill) must not
+                # inflate either counter
+                n_drafted = len(drafts.get(s, ()))
+                self.spec_proposed += n_drafted
+                self.spec_accepted += min(n_acc_s, n_drafted)
+                burst = [int(out_host[s, j]) for j in range(n_acc_s + 1)]
+                burst += [int(extra_host[s, j]) for j in range(m)]
+                for tok in burst:
+                    if self.slot_req[s] is None:
+                        break                 # finished mid-burst (eos/len)
+                    self._commit_token(s, tok)
+                    self.spec_round_tokens += 1
         return True
 
     def _commit_token(self, slot: int, tok: int) -> None:
@@ -2845,17 +3097,19 @@ class InferenceEngine:
             # admission reserved every prompt page up front, and the
             # scan's garbage rows above each prefill watermark scatter
             # to the trash page.
-            active = self._paged_reserve_active(active, n)
+            with self.steptrace.scope("admit"):
+                active = self._paged_reserve_active(active, n)
             if not active or not self.slot_prefill:
                 return False
-        entries = []
-        for slot in sorted(self.slot_prefill):
-            st = self.slot_prefill[slot]
-            chunk = st["req"].prompt_ids[st["done"]: st["done"] + C]
-            entries.append((slot, st, chunk))
-        tok, starts, lens = self._chunk_batch_rows(entries)
-        advance = np.zeros((self.max_slots,), np.int32)
-        advance[active] = n
+        with self.steptrace.scope("index_build"):
+            entries = []
+            for slot in sorted(self.slot_prefill):
+                st = self.slot_prefill[slot]
+                chunk = st["req"].prompt_ids[st["done"]: st["done"] + C]
+                entries.append((slot, st, chunk))
+            tok, starts, lens = self._chunk_batch_rows(entries)
+            advance = np.zeros((self.max_slots,), np.int32)
+            advance[active] = n
         # per-phase device accounting for the ONE fused dispatch: the
         # wall time is split between prefill and decode in proportion
         # to each half's FLOPs (token-count fallback without a cost
@@ -2867,76 +3121,88 @@ class InferenceEngine:
         dc_tokens = n * len(active)
         dc_keys = sum(CostModel.block_keys(n, int(self.slot_len[s]))
                       for s in active)
-        t0 = time.monotonic()
-        self.rng, sub = jax.random.split(self.rng)
-        if self.paged is not None:
-            # view must hold: each prefill row's chunk + the scan's n
-            # garbage rows above it (done+C+n), and each occupied
-            # decode row's dead chunk window (len+C; the scan's real n
-            # rows overwrite its head) — the same extents
-            # _mixed_feasible bounds against cache_len
-            need = max(
-                [st["done"] + C + n for _, st, _ in entries]
-                + [int(self.slot_len[s]) + C for s in range(self.max_slots)
-                   if s not in self.slot_prefill
-                   and self.slot_req[s] is not None] + [C + n])
-            W = self._paged_width(need)
-            starts = np.minimum(starts, W - C)
-            valid = np.zeros((self.max_slots,), np.int32)
+        # one scope spans through the two note_device_phase calls below
+        # (their dt shares must land inside it so the device deduction
+        # balances) — and the dispatch calls themselves, so a raising
+        # dispatch can't leak an open scope frame
+        with self.steptrace.scope("dispatch_wait"):
+            t0 = time.monotonic()
+            self.rng, sub = jax.random.split(self.rng)
+            if self.paged is not None:
+                # view must hold: each prefill row's chunk + the scan's
+                # n garbage rows above it (done+C+n), and each occupied
+                # decode row's dead chunk window (len+C; the scan's
+                # real n rows overwrite its head) — the same extents
+                # _mixed_feasible bounds against cache_len
+                need = max(
+                    [st["done"] + C + n for _, st, _ in entries]
+                    + [int(self.slot_len[s]) + C
+                       for s in range(self.max_slots)
+                       if s not in self.slot_prefill
+                       and self.slot_req[s] is not None] + [C + n])
+                W = self._paged_width(need)
+                starts = np.minimum(starts, W - C)
+                valid = np.zeros((self.max_slots,), np.int32)
+                for slot, st, chunk in entries:
+                    starts[slot] = st["done"]
+                    valid[slot] = len(chunk)
+                    self._paged_cow_fork(slot, st["done"], len(chunk))
+                for s in active:
+                    valid[s] = n
+                    self._paged_cow_fork(s, int(self.slot_len[s]), n)
+                chunk_last, toks, self.paged.kv = self._pg_mixed(
+                    self.params, self.paged.kv,
+                    jnp.asarray(self.paged.gather_idx(W)),
+                    jnp.asarray(tok), jnp.asarray(starts),
+                    jnp.asarray(lens), jnp.asarray(advance),
+                    jnp.asarray(self.slot_last_token), sub,
+                    jnp.asarray(self._temperature),
+                    jnp.asarray(self._top_k),
+                    jnp.asarray(self._top_p),
+                    jnp.asarray(self._greedy),
+                    jnp.asarray(self.paged.scatter_idx(starts, valid, C)),
+                    n=n,
+                )
+            else:
+                chunk_last, toks, self.cache = self._mixed(
+                    self.params, self.cache, jnp.asarray(tok),
+                    jnp.asarray(starts), jnp.asarray(lens),
+                    jnp.asarray(advance),
+                    jnp.asarray(self.slot_last_token), sub,
+                    jnp.asarray(self._temperature),
+                    jnp.asarray(self._top_k),
+                    jnp.asarray(self._top_p),
+                    jnp.asarray(self._greedy),
+                    n=n,
+                )
+            toks_host = np.asarray(toks)  # forces the dispatch's results
+            dt = time.monotonic() - t0
+            self.mixed_blocks += 1
             for slot, st, chunk in entries:
-                starts[slot] = st["done"]
-                valid[slot] = len(chunk)
-                self._paged_cow_fork(slot, st["done"], len(chunk))
+                st["last_logits"] = chunk_last[slot:slot + 1]
+                st["done"] += len(chunk)
+            self._trace_chunks(entries, dt, batched=True, fused=True)
+            cm = self.cost_model
+            if cm is not None:
+                pf, df = (cm.step_flops(pf_tokens, pf_keys),
+                          cm.step_flops(dc_tokens, dc_keys))
+                share = pf / (pf + df) if pf + df > 0 else 0.5
+            else:
+                share = pf_tokens / max(pf_tokens + dc_tokens, 1)
+            self._note_device_phase(
+                "prefill", tokens=pf_tokens, attended_keys=pf_keys,
+                weight_passes=1, kv_read_tokens=pf_keys, dt=dt * share)
+            self._note_device_phase(
+                "decode", tokens=dc_tokens, attended_keys=dc_keys,
+                weight_passes=n, kv_read_tokens=dc_keys,
+                dt=dt * (1 - share))
+        with self.steptrace.scope("sample_commit"):
+            # decode members waited the whole fused dispatch, like the
+            # prefill members booked in _trace_chunks
             for s in active:
-                valid[s] = n
-                self._paged_cow_fork(s, int(self.slot_len[s]), n)
-            chunk_last, toks, self.paged.kv = self._pg_mixed(
-                self.params, self.paged.kv,
-                jnp.asarray(self.paged.gather_idx(W)),
-                jnp.asarray(tok), jnp.asarray(starts),
-                jnp.asarray(lens), jnp.asarray(advance),
-                jnp.asarray(self.slot_last_token), sub,
-                jnp.asarray(self._temperature),
-                jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p),
-                jnp.asarray(self._greedy),
-                jnp.asarray(self.paged.scatter_idx(starts, valid, C)),
-                n=n,
-            )
-        else:
-            chunk_last, toks, self.cache = self._mixed(
-                self.params, self.cache, jnp.asarray(tok),
-                jnp.asarray(starts), jnp.asarray(lens),
-                jnp.asarray(advance),
-                jnp.asarray(self.slot_last_token), sub,
-                jnp.asarray(self._temperature),
-                jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p),
-                jnp.asarray(self._greedy),
-                n=n,
-            )
-        toks_host = np.asarray(toks)  # forces the dispatch's results
-        dt = time.monotonic() - t0
-        self.mixed_blocks += 1
-        for slot, st, chunk in entries:
-            st["last_logits"] = chunk_last[slot:slot + 1]
-            st["done"] += len(chunk)
-        self._trace_chunks(entries, dt, batched=True, fused=True)
-        cm = self.cost_model
-        if cm is not None:
-            pf, df = (cm.step_flops(pf_tokens, pf_keys),
-                      cm.step_flops(dc_tokens, dc_keys))
-            share = pf / (pf + df) if pf + df > 0 else 0.5
-        else:
-            share = pf_tokens / max(pf_tokens + dc_tokens, 1)
-        self._note_device_phase(
-            "prefill", tokens=pf_tokens, attended_keys=pf_keys,
-            weight_passes=1, kv_read_tokens=pf_keys, dt=dt * share)
-        self._note_device_phase(
-            "decode", tokens=dc_tokens, attended_keys=dc_keys,
-            weight_passes=n, kv_read_tokens=dc_keys, dt=dt * (1 - share))
-        self._finalize_prefills()
-        self._commit_block(active, toks_host, n)
+                self.slot_req[s].cp_add("decode_dispatch", dt)
+            self._finalize_prefills()
+            self._commit_block(active, toks_host, n)
         return True
 
     def _commit_block(self, active: list[int], toks_host, n: int) -> None:
@@ -2957,6 +3223,11 @@ class InferenceEngine:
         """One engine iteration. Returns False when fully idle."""
         with self._lock:
             before = self.dispatch_meter.total
+            # the flight recorder brackets the WHOLE step; the timeline
+            # (per-segment intervals for the Perfetto dual-lane view)
+            # is only captured while a Chrome-JSONL sink is attached
+            self.steptrace.step_begin(
+                timeline=getattr(self.tracer, "has_file_sink", False))
             busy = False
             try:
                 busy = self._step_locked()
@@ -2966,12 +3237,17 @@ class InferenceEngine:
                 # idle background-loop polls (~10 Hz while waiting on
                 # _wake) must not record 0-dispatch steps, or the
                 # per-step rolling mean decays to 0 on any bursty
-                # server and the metric stops meaning anything
+                # server and the metric stops meaning anything (the
+                # steptrace ring follows the same rule)
                 if busy or spent:
                     self.dispatch_meter.note_step(spent)
+                    self.steptrace.step_end(self.tracer)
+                else:
+                    self.steptrace.step_abort()
 
     def _step_locked(self) -> bool:
-        self._admit()
+        with self.steptrace.scope("admit"):
+            self._admit()
         budget = self.prefill_budget
         active = self._ready_slots()
         # A speculative engine at decode_steps=1 keeps speculating
@@ -2990,19 +3266,21 @@ class InferenceEngine:
         # applies when speculation actually CAN run this step —
         # non-greedy traffic on a spec engine must not lose the fused
         # step too.
-        spec_composes = (
-            (self.decode_steps == 1 or self.role == "decode")
-            and self._spec_applicable(active)
-            # the verify runs AFTER this step's chunks advance each
-            # prefill row (by up to budget chunks) — account for that
-            # movement here, or near the cache tail the composition
-            # promise breaks: the feasible fused dispatch is skipped
-            # and _try_speculative then declines post-advance, leaving
-            # 2 dispatches for 1 token
-            and all(st["done"] + budget * self.chunked_prefill
-                    + self.speculative_k + 1 <= self.cache_len
-                    for st in self.slot_prefill.values())
-        )
+        with self.steptrace.scope("plan"):
+            spec_composes = (
+                (self.decode_steps == 1 or self.role == "decode")
+                and self._spec_applicable(active)
+                # the verify runs AFTER this step's chunks advance each
+                # prefill row (by up to budget chunks) — account for
+                # that movement here, or near the cache tail the
+                # composition promise breaks: the feasible fused
+                # dispatch is skipped and _try_speculative then
+                # declines post-advance, leaving 2 dispatches for 1
+                # token
+                and all(st["done"] + budget * self.chunked_prefill
+                        + self.speculative_k + 1 <= self.cache_len
+                        for st in self.slot_prefill.values())
+            )
         pre_progress = False
         if (self.mixed_step and self.slot_prefill and active
                 and not spec_composes):
@@ -3022,8 +3300,9 @@ class InferenceEngine:
                 budget = 1
                 active = self._ready_slots()
             if self.slot_prefill and active:
-                n = self._plan_block(active)
-                ok, why = self._mixed_feasible(active, n)
+                with self.steptrace.scope("plan"):
+                    n = self._plan_block(active)
+                    ok, why = self._mixed_feasible(active, n)
                 if ok:
                     # the decode-replica suspension gate is GONE
                     # (ISSUE 9 satellite): on role="decode" the branch
@@ -3068,26 +3347,64 @@ class InferenceEngine:
             self._update_active_stats()
             return True
         self.rng, sub = jax.random.split(self.rng)
-        n = self._plan_block(active)
-        use_multi = (
-            n > 1
-            # (a spec engine reaching here DIDN'T speculate this step —
-            # draft miss / non-greedy — and must not also forfeit the
-            # block amortization; the fused spec round otherwise spans
-            # the same plan itself)
-            # every row the block writes must land inside the cache
-            and all(self.slot_len[s] + n <= self.cache_len
-                    for s in active)
-        )
+        with self.steptrace.scope("plan"):
+            n = self._plan_block(active)
+            use_multi = (
+                n > 1
+                # (a spec engine reaching here DIDN'T speculate this
+                # step — draft miss / non-greedy — and must not also
+                # forfeit the block amortization; the fused spec round
+                # otherwise spans the same plan itself)
+                # every row the block writes must land inside the cache
+                and all(self.slot_len[s] + n <= self.cache_len
+                        for s in active)
+            )
         if use_multi:
-            t0 = time.monotonic()
             if self.paged is not None:
-                active = self._paged_reserve_active(active, n)
+                with self.steptrace.scope("admit"):
+                    active = self._paged_reserve_active(active, n)
                 if not active:
                     return True  # reservation finished/preempted them all
-                toks = self._paged_decode_dispatch(active, n, sub)
+            with self.steptrace.scope("dispatch_wait"):
+                t0 = time.monotonic()
+                if self.paged is not None:
+                    toks = self._paged_decode_dispatch(active, n, sub)
+                else:
+                    toks, self.cache = self._decode_multi(
+                        self.params, self.cache,
+                        jnp.asarray(self.slot_last_token),
+                        sub,
+                        jnp.asarray(self._temperature),
+                        jnp.asarray(self._top_k),
+                        jnp.asarray(self._top_p),
+                        jnp.asarray(self._greedy),
+                        n=n,
+                    )
+                toks_host = np.asarray(toks)
+                keys = sum(CostModel.block_keys(n, int(self.slot_len[s]))
+                           for s in active)
+                dt = time.monotonic() - t0
+                self._note_device_phase(
+                    "decode", tokens=n * len(active), attended_keys=keys,
+                    weight_passes=n, kv_read_tokens=keys, dt=dt)
+            with self.steptrace.scope("sample_commit"):
+                for s in active:
+                    self.slot_req[s].cp_add("decode_dispatch", dt)
+                self._commit_block(active, toks_host, n)
+            self._update_active_stats()
+            return True
+        if self.paged is not None:
+            with self.steptrace.scope("admit"):
+                active = self._paged_reserve_active(active, 1)
+            if not active:
+                return True
+        with self.steptrace.scope("dispatch_wait"):
+            t0 = time.monotonic()
+            if self.paged is not None:
+                next_tok = self._paged_decode_dispatch(active, 1, sub)
+                next_tok = next_tok[:, 0]
             else:
-                toks, self.cache = self._decode_multi(
+                next_tok, self.cache = self._decode(
                     self.params, self.cache,
                     jnp.asarray(self.slot_last_token),
                     sub,
@@ -3095,44 +3412,19 @@ class InferenceEngine:
                     jnp.asarray(self._top_k),
                     jnp.asarray(self._top_p),
                     jnp.asarray(self._greedy),
-                    n=n,
                 )
-            toks_host = np.asarray(toks)
-            keys = sum(CostModel.block_keys(n, int(self.slot_len[s]))
+            next_host = np.asarray(next_tok)
+            keys = sum(CostModel.block_keys(1, int(self.slot_len[s]))
                        for s in active)
+            dt = time.monotonic() - t0
             self._note_device_phase(
-                "decode", tokens=n * len(active), attended_keys=keys,
-                weight_passes=n, kv_read_tokens=keys,
-                dt=time.monotonic() - t0)
-            self._commit_block(active, toks_host, n)
-            self._update_active_stats()
-            return True
-        t0 = time.monotonic()
-        if self.paged is not None:
-            active = self._paged_reserve_active(active, 1)
-            if not active:
-                return True
-            next_tok = self._paged_decode_dispatch(active, 1, sub)
-            next_tok = next_tok[:, 0]
-        else:
-            next_tok, self.cache = self._decode(
-                self.params, self.cache,
-                jnp.asarray(self.slot_last_token),
-                sub,
-                jnp.asarray(self._temperature),
-                jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p),
-                jnp.asarray(self._greedy),
-            )
-        next_host = np.asarray(next_tok)
-        keys = sum(CostModel.block_keys(1, int(self.slot_len[s]))
-                   for s in active)
-        self._note_device_phase(
-            "decode", tokens=len(active), attended_keys=keys,
-            weight_passes=1, kv_read_tokens=keys,
-            dt=time.monotonic() - t0)
-        for slot in active:
-            self._commit_token(slot, int(next_host[slot]))
+                "decode", tokens=len(active), attended_keys=keys,
+                weight_passes=1, kv_read_tokens=keys, dt=dt)
+        with self.steptrace.scope("sample_commit"):
+            for s in active:
+                self.slot_req[s].cp_add("decode_dispatch", dt)
+            for slot in active:
+                self._commit_token(slot, int(next_host[slot]))
         self._update_active_stats()
         return True
 
@@ -3206,6 +3498,42 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             snap["prefix_index_entries"] = self.prefix_cache.n_entries
         return snap
+
+    def debug_requests(self, limit: int = 64) -> dict:
+        """The ``GET /debug/requests`` payload: the recent-finished ring
+        with each request's critical-path breakdown (CP_SEGMENTS). The
+        engine segments partition the request's submit→finish wall
+        clock (``host_gap`` is the residual); ``stream_flush`` is the
+        API-side SSE tail, measured concurrently with decode and
+        reported alongside, and may still be absent for a stream whose
+        handler hasn't closed yet. Reads are lock-free snapshots of the
+        GIL-atomic deque (HTTP threads vs. the finishing threads)."""
+        now = time.monotonic()
+        out = []
+        for r in list(self.finished)[-limit:]:
+            wall = (r.finish_time - r.submit_time
+                    if r.finish_time is not None else None)
+            out.append({
+                "uid": r.uid,
+                "finish_reason": r.finish_reason,
+                "prompt_tokens": len(r.prompt_ids),
+                "completion_tokens": r.n_generated,
+                "cache": r.cache_outcome,
+                "ttft_s": (round(r.ttft_s, 6)
+                           if r.ttft_s is not None else None),
+                "wall_s": round(wall, 6) if wall is not None else None,
+                "age_s": (round(now - r.finish_time, 3)
+                          if r.finish_time is not None else None),
+                "segments": {k: round(v, 6) for k, v in r.cp.items()},
+            })
+        return {
+            "capacity": self.finished.maxlen,
+            "segments": list(CP_SEGMENTS),
+            "critical_path_seconds_total":
+                {k: round(v, 6) for k, v in
+                 self.stats.critical_path_snapshot().items()},
+            "finished": out,
+        }
 
     def page_capacity_detail(self, prompt_tokens: int) -> dict:
         """Why a prompt 422s: the page math for the API error body."""

@@ -340,6 +340,16 @@ class KVPoolServer:
         self.handoff_claims = 0
         self.handoff_expired = 0
         self.handoff_rejected = 0
+        # per-op wire+serialize latency of the handoff data plane
+        # (hput = prefill publish, hclaim = decode claim) — the
+        # server-side cross-check of the engine's per-request
+        # `handoff_wire` critical-path segment (ISSUE 11 satellite).
+        # HistogramAccumulators carry their own locks (handler threads
+        # observe, the scrape thread snapshots).
+        from llm_in_practise_tpu.obs.registry import HistogramAccumulator
+
+        self.handoff_wire = {"hput": HistogramAccumulator(),
+                             "hclaim": HistogramAccumulator()}
         self._namespaces: set[str] = set()  # guarded-by: _acct_lock
         # live entries per namespace: a namespace whose last entry is
         # evicted releases its slot (rolling model redeploys would
@@ -372,10 +382,20 @@ class KVPoolServer:
                     if prelude is None:
                         return            # clean close between messages
                     try:
+                        # wire+serialize timing for the handoff ops
+                        # (kvpool_handoff_wire_seconds): prelude-seen →
+                        # response-sent covers the payload recv (wire),
+                        # the store work, and the reply — the
+                        # server-side cross-check of the per-request
+                        # handoff_wire critical-path segment
+                        t0 = time.perf_counter()
                         header, payload = _recv_msg(
                             self.request, max_payload=pool.max_payload,
                             prelude=prelude)
                         pool._dispatch(self.request, header, payload)
+                        acc = pool.handoff_wire.get(header.get("op"))
+                        if acc is not None:
+                            acc.observe(time.perf_counter() - t0)
                     except Exception as e:  # noqa: BLE001 — malformed
                         # header, over-cap frame, mid-read EOF, bad op
                         # args: contain the fault to THIS connection
@@ -451,6 +471,12 @@ class KVPoolServer:
                        lambda: self.handoff_pages,
                        "live KV pages pinned by unclaimed page-wise "
                        "handoff entries (0 for bucket-width producers)")
+        reg.histogram_func(
+            "kvpool_handoff_wire_seconds",
+            lambda: [({"op": op}, acc)
+                     for op, acc in sorted(self.handoff_wire.items())],
+            "handoff op wire+serialize time, prelude-seen to "
+            "response-sent (hput = publish, hclaim = claim)")
         return reg
 
     def metrics_text(self) -> str:

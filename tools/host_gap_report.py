@@ -1,0 +1,139 @@
+"""host_gap_report — one-shot host-gap table from a running server.
+
+Scrapes a model server's ``/metrics`` (the host-gap families the
+steptrace recorder exports — ``llm_host_gap_seconds_total{activity=…}``,
+``llm_step_wall_seconds_total``, ``llm_device_busy_fraction``,
+``llm_host_gap_fraction``) and prints the per-activity table the serve
+benches embed in their artifacts (``observability.host_gap``), so "where
+does the host spend the time between dispatches" is one command against
+a live replica instead of a bench run.
+
+Usage::
+
+    python tools/host_gap_report.py --url http://127.0.0.1:8000
+    python tools/host_gap_report.py --url ... --json   # machine-readable
+
+Exit codes: 0 on success, 1 when the scrape fails or the families are
+absent (server predates the recorder, or LLM_TPU_STEPTRACE=off).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import urllib.request
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_samples(text: str) -> list[tuple[str, dict, float]]:
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            continue
+        labels = dict(_LABEL.findall(m.group(2) or ""))
+        try:
+            out.append((m.group(1), labels, float(m.group(3))))
+        except ValueError:
+            continue
+    return out
+
+
+def host_gap_from_metrics(text: str) -> dict | None:
+    """Assemble the host-gap block (the bench artifact shape) from an
+    exposition scrape; None when the families are absent."""
+    activities: dict[str, float] = {}
+    wall = device_busy = host_gap = steps = None
+    for name, labels, value in parse_samples(text):
+        if name == "llm_host_gap_seconds_total" and "activity" in labels:
+            activities[labels["activity"]] = value
+        elif name == "llm_step_wall_seconds_total":
+            wall = value
+        elif name == "llm_engine_steps_total":
+            steps = value
+        elif name == "llm_device_busy_fraction":
+            device_busy = value
+        elif name == "llm_host_gap_fraction":
+            host_gap = value
+    if not activities or wall is None:
+        return None
+    host_total = sum(activities.values())
+    other = activities.get("other", 0.0)
+    return {
+        "steps": steps,
+        "step_wall_seconds_total": wall,
+        "host_seconds": activities,
+        "host_seconds_total": host_total,
+        "device_seconds_total": max(0.0, wall - host_total),
+        "device_busy_fraction": device_busy,
+        "host_gap_fraction": host_gap,
+        # 0.0 with no recorded wall — same rule as StepTrace: a server
+        # that measured nothing (fresh, idle, or recorder off) must
+        # trip the gate warning, never pass it vacuously
+        "coverage": ((wall - other) / wall) if wall > 0 else 0.0,
+    }
+
+
+def format_table(block: dict) -> str:
+    wall = block["step_wall_seconds_total"] or 1e-12
+    lines = [
+        f"{'activity':<16} {'seconds':>12} {'% of wall':>10}",
+        "-" * 40,
+    ]
+    for name, secs in sorted(block["host_seconds"].items(),
+                             key=lambda kv: -kv[1]):
+        lines.append(f"{name:<16} {secs:>12.4f} {100 * secs / wall:>9.2f}%")
+    dev = block["device_seconds_total"]
+    lines.append("-" * 40)
+    lines.append(f"{'device (busy)':<16} {dev:>12.4f} "
+                 f"{100 * dev / wall:>9.2f}%")
+    lines.append(f"{'step wall':<16} {wall:>12.4f} {'100.00%':>10}")
+    lines.append("")
+    if block["host_gap_fraction"] is not None:
+        lines.append(f"rolling host-gap fraction: "
+                     f"{block['host_gap_fraction']:.4f}  "
+                     f"(device busy {block['device_busy_fraction']:.4f})")
+    lines.append(f"coverage (attributed / wall): {block['coverage']:.4f}"
+                 + ("" if block["coverage"] >= 0.95
+                    else "  ** below the 0.95 gate **"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="model-server base URL (scrapes <url>/metrics)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the block as JSON instead of the table")
+    args = ap.parse_args(argv)
+    url = args.url.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except OSError as e:
+        print(f"host_gap_report: cannot scrape {url}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    block = host_gap_from_metrics(text)
+    if block is None:
+        print("host_gap_report: no host-gap families at "
+              f"{url} (old server, or LLM_TPU_STEPTRACE=off)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(block, indent=2, sort_keys=True))
+    else:
+        print(format_table(block))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -235,6 +235,11 @@ def main() -> None:
                        "collective_seconds_total":
                            round(engine.collective_seconds_total, 6)},
                    "debug_kv": engine.debug_kv(),
+                   # host-gap dial (obs/steptrace.py; full block incl.
+                   # per-activity totals rides in observability.host_gap)
+                   "host_gap_fraction": round(
+                       engine.steptrace.snapshot()["host_gap_fraction"],
+                       4),
                    "mixed_blocks": engine.mixed_blocks,
                    "dispatches_per_step":
                        round(engine.dispatch_meter.mean_per_step, 3),
