@@ -166,12 +166,6 @@ def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
     carries a reason and a dead engine thread surfaces as per-request
     timeouts instead of a hang.
     """
-    import queue as queue_mod
-
-    from llm_in_practise_tpu.obs.trace import new_context
-    from llm_in_practise_tpu.serve import engine as engine_mod
-    from llm_in_practise_tpu.serve.engine import SamplingParams
-
     done = []          # (request | None, failure_reason | None)
     lock = threading.Lock()
     queue = list(range(n_requests))
@@ -184,23 +178,8 @@ def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
                 if not queue:
                     return
                 i = queue.pop()
-            try:
-                # each bench request is a traced root: without this the
-                # direct-engine path records no spans and the artifact's
-                # obs_snapshot trace summary would be structurally empty
-                req = engine.submit(prompt_ids_list[picks[i]],
-                                    SamplingParams(greedy=True,
-                                                   max_tokens=max_tokens),
-                                    trace=new_context())
-                while True:  # drain the stream; bounded wait per token
-                    item = req.tokens.get(timeout=timeout)
-                    if item is engine_mod._FINISH:
-                        break
-                row = (req, None)
-            except queue_mod.Empty:
-                row = (None, f"token_timeout>{timeout:g}s")
-            except Exception as e:
-                row = (None, f"{type(e).__name__}: {e}")
+            row = _submit_and_drain(engine, prompt_ids_list[picks[i]],
+                                    max_tokens, timeout)
             with lock:
                 done.append(row)
 
@@ -211,12 +190,48 @@ def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    return {"mode": "inprocess",
+            **_engine_rows_aggregate(done, concurrency, n_requests, wall)}
 
-    # requests the engine SHED (admission control: finish_reason
-    # "queue_full", zero tokens) are failures for success-rate purposes —
-    # the SLA percentiles describe served requests only, with the shed
-    # fraction reported alongside so a config can't "pass" by serving
-    # almost nothing
+
+def _submit_and_drain(engine, ids, max_tokens, timeout, constraint=None):
+    """Submit one engine request (as a traced root — without this the
+    direct-engine path records no spans and the artifact's obs_snapshot
+    trace summary would be structurally empty) and drain its stream
+    with a bounded per-token wait. Returns ``(request, None)`` or
+    ``(None, failure_reason)`` — the ONE drain/reason convention both
+    the closed ladder and the trace replay book through."""
+    import queue as queue_mod
+
+    from llm_in_practise_tpu.obs.trace import new_context
+    from llm_in_practise_tpu.serve import engine as engine_mod
+    from llm_in_practise_tpu.serve.engine import SamplingParams
+
+    try:
+        req = engine.submit(ids,
+                            SamplingParams(greedy=True,
+                                           max_tokens=max_tokens,
+                                           constraint=constraint),
+                            trace=new_context())
+        while True:  # drain the stream; bounded wait per token
+            item = req.tokens.get(timeout=timeout)
+            if item is engine_mod._FINISH:
+                break
+        return req, None
+    except queue_mod.Empty:
+        return None, f"token_timeout>{timeout:g}s"
+    except Exception as e:  # noqa: BLE001 — a bench row must say why
+        return None, f"{type(e).__name__}: {e}"
+
+
+def _engine_rows_aggregate(done, concurrency, n_requests, wall):
+    """Success/failure accounting over ``(request, reason)`` rows —
+    shared by the closed ladder and the trace replay. Requests the
+    engine SHED (admission control: finish_reason "queue_full", zero
+    tokens) are failures for success-rate purposes — the SLA
+    percentiles describe served requests only, with the shed fraction
+    reported alongside so a config can't "pass" by serving almost
+    nothing."""
     oks = [r for r, err in done
            if err is None and r.finish_time is not None
            and r.finish_reason != "queue_full"]
@@ -227,12 +242,46 @@ def run_level_inprocess(engine, prompt_ids_list, concurrency, n_requests,
             else ("no_finish_time" if r.finish_time is None else None))
         if reason:
             failures[reason] = failures.get(reason, 0) + 1
-    row = _aggregate(
+    return _aggregate(
         concurrency, n_requests, len(oks), failures,
         [r.ttft_s for r in oks if r.ttft_s is not None],
         [r.tpot_s for r in oks if r.tpot_s is not None],
         sum(r.n_generated for r in oks), wall)
-    return {"mode": "inprocess", **row}
+
+
+def run_trace_inprocess(engine, prompt_ids_list, schedule, *,
+                        timeout=600.0, workers=32, constraint=None):
+    """Open-loop TRACE-REPLAY against ``InferenceEngine.submit``
+    (ISSUE 12 satellite / ROADMAP item 2b first slice): requests fire
+    at a seeded bursty schedule's instants (serve/arrivals.py) with the
+    schedule's mixed prompt/output lengths, instead of the closed
+    ladder's back-to-back uniform load. Row shape matches the ladder
+    rows (mode "trace_replay"), with the realized schedule statistics
+    attached — including arrival LATENESS: workers drain their streams,
+    so in-flight requests are bounded at ``workers`` and arrivals past
+    that fire late (the open-loop promise degrades); the row states how
+    late, instead of silently reporting the scheduled load as applied."""
+    from llm_in_practise_tpu.serve import arrivals as arrivals_mod
+
+    rng = random.Random(0)
+    picks = [rng.randrange(len(prompt_ids_list)) for _ in schedule]
+
+    def submit(arrival):
+        ids = list(prompt_ids_list[picks.pop()])
+        ids = (ids * (arrival.prompt_tokens // max(len(ids), 1) + 1)
+               )[:arrival.prompt_tokens]
+        return _submit_and_drain(engine, ids, arrival.max_tokens,
+                                 timeout, constraint=constraint)
+
+    t0 = time.perf_counter()
+    late: list = []
+    done = arrivals_mod.replay(schedule, submit, workers=workers,
+                               lateness=late)
+    wall = time.perf_counter() - t0
+    return {"mode": "trace_replay",
+            "arrivals": {**arrivals_mod.describe(schedule),
+                         **arrivals_mod.lateness_stats(late)},
+            **_engine_rows_aggregate(done, workers, len(schedule), wall)}
 
 
 def main():

@@ -48,6 +48,11 @@ Activity glossary (docs/observability.md "Host timeline"):
                    gather/scatter arrays)
 ``draft_propose``  speculative drafting on the host (ngram scan or
                    draft-model sync + roll)
+``grammar_compile`` lazy per-state grammar compilation — vocab-wide token
+                   classification on first visit of an automaton state
+                   (serve/constrain.py, ISSUE 12)
+``grammar_mask``   per-step staging of the grammar logit masks for
+                   constrained slots (compiled-state lookups + array fill)
 ``dispatch_wait``  jitted-dispatch windows net of the device-booked time
 ``sample_commit``  per-token commit/emit loops + prefill finalization
 ``publish``        handoff entry gather/queue on the engine thread
@@ -63,8 +68,8 @@ import time
 from collections import deque
 
 ACTIVITIES = ("queue_drain", "admit", "plan", "index_build",
-              "draft_propose", "dispatch_wait", "sample_commit",
-              "publish", "other")
+              "draft_propose", "grammar_compile", "grammar_mask",
+              "dispatch_wait", "sample_commit", "publish", "other")
 
 # synthetic Chrome-trace thread ids for the dual-lane view; request
 # spans use real thread idents (< 2^31), so these can't collide

@@ -15,6 +15,17 @@ from llm_in_practise_tpu.serve.engine import (  # noqa: F401
     SamplingParams,
     shard_params_for_serving,
 )
+from llm_in_practise_tpu.serve.constrain import (  # noqa: F401
+    ConstraintError,
+    TokenAutomaton,
+    compile_request_constraint,
+    compile_schema,
+    validate_instance,
+)
+from llm_in_practise_tpu.serve.arrivals import (  # noqa: F401
+    Arrival,
+    synthesize as synthesize_arrivals,
+)
 from llm_in_practise_tpu.serve.api import OpenAIServer, build_prompt  # noqa: F401
 from llm_in_practise_tpu.serve.adapters import (  # noqa: F401
     build_adapter_engines,

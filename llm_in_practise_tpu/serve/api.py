@@ -31,6 +31,7 @@ throughput is owned by the engine's single background loop.
 
 from __future__ import annotations
 
+import dataclasses
 import html
 import json
 import sys
@@ -43,7 +44,7 @@ import numpy as np
 from llm_in_practise_tpu.data.sft import IM_START, render_chatml
 from llm_in_practise_tpu.obs.registry import Registry
 from llm_in_practise_tpu.obs.trace import get_tracer, parse_traceparent
-from llm_in_practise_tpu.serve import schemas
+from llm_in_practise_tpu.serve import constrain, schemas
 from llm_in_practise_tpu.serve.engine import InferenceEngine, SamplingParams
 from llm_in_practise_tpu.serve.http_util import (
     JsonHandler,
@@ -105,10 +106,66 @@ class OpenAIServer:
         # it. Default = the process tracer, so colocated components share
         # one ring and GET /debug/traces sees the whole request.
         self.tracer = tracer if tracer is not None else get_tracer()
+        # Structured output (serve/constrain.py, ISSUE 12): the
+        # per-server grammar compile cache plus the per-engine decoded
+        # vocab it compiles against. Handler threads compile; repeat
+        # schemas (the agent-loop shape) hit the cache.
+        self._constraints = constrain.ConstraintCompiler()
+        self._vocab_lock = threading.Lock()
+        self._constraint_vocabs: dict[int, list[str]] = {}  # guarded-by: _vocab_lock
+        self._structured_lock = threading.Lock()
+        # llm_structured_requests_total{kind=…}; scrapes read the ints
+        # lock-free (monotone counters — the spec_* convention)
+        self._structured_counts = {"json_object": 0, "json_schema": 0,
+                                   "tool_call": 0}  # guarded-by: _structured_lock
         # unified metrics registry (obs/registry.py): scrape-time
         # callbacks over the live engine/meter counters — the ONE
         # exposition renderer, replacing the hand-formatted text block
         self.registry = self._build_registry()
+
+    # --- structured output ----------------------------------------------------
+
+    def _constraint_vocab(self, engine: InferenceEngine) -> tuple[list, int]:
+        """Decoded per-id vocab pieces for ``engine`` (cached). Raises
+        :class:`~llm_in_practise_tpu.serve.constrain.ConstraintError`
+        when the model exposes no vocab size (structured output is then
+        a 422 — the server cannot promise schema conformance)."""
+        key = id(engine)
+        with self._vocab_lock:
+            got = self._constraint_vocabs.get(key)
+        if got is None:
+            vs = getattr(getattr(engine.model, "config", None),
+                         "vocab_size", None)
+            if vs is None:
+                raise constrain.ConstraintError(
+                    "this model exposes no vocab_size; structured "
+                    "output is unavailable")
+            got = constrain.vocab_strings(self.tokenizer, int(vs))
+            with self._vocab_lock:
+                self._constraint_vocabs[key] = got
+        return got, key
+
+    def _compile_constraint(self, engine: InferenceEngine,
+                            req: "schemas.ChatCompletionRequest"):
+        """Request fields → shared compiled automaton (or None). Raises
+        ConstraintError on invalid/unsupported specs (HTTP 422)."""
+        rf_type = (req.response_format or {}).get("type")
+        if (rf_type in (None, "text")
+                and req.tool_choice in (None, "auto", "none")):
+            # unconstrained request (the SDK default response_format
+            # {"type": "text"} included): never touch the vocab cache
+            # — a model without vocab_size must still serve plain chat
+            return None
+        vocab, vocab_key = self._constraint_vocab(engine)
+        return self._constraints.get(
+            response_format=req.response_format, tools=req.tools,
+            tool_choice=req.tool_choice, vocab=vocab,
+            vocab_key=vocab_key, eos_id=engine.eos_id)
+
+    def _note_structured(self, kind: str) -> None:
+        with self._structured_lock:
+            self._structured_counts[kind] = (
+                self._structured_counts.get(kind, 0) + 1)
 
     def engine_for(self, model: str | None) -> InferenceEngine | None:
         if model in (None, "", self.model_name):
@@ -302,6 +359,20 @@ class OpenAIServer:
             greedy=req.temperature == 0.0,
             max_tokens=req.max_tokens,
         )
+        # structured output (serve/constrain.py): compile the grammar
+        # the engine will enforce in-dispatch; an invalid/unsupported
+        # schema is a client error — 422 BEFORE any engine work
+        constraint_kind = None
+        try:
+            automaton = self._compile_constraint(engine, req)
+        except constrain.ConstraintError as e:
+            return send_json(422, {"error": {
+                "message": str(e), "type": "invalid_request_error",
+                "code": "invalid_constraint"}})
+        if automaton is not None:
+            constraint_kind = automaton.kind
+            self._note_structured(constraint_kind)
+            params = dataclasses.replace(params, constraint=automaton)
         # disaggregated serving: a router that already prefilled this
         # prompt elsewhere points us at the pinned KV entry; a lost claim
         # (expired/claimed/unreachable) degrades to local prefill — the
@@ -522,11 +593,27 @@ class OpenAIServer:
                 return queue_full_429("request timed out waiting for a slot")
             text = self.tokenizer.decode(out_ids)
             usage = schemas.Usage(len(prompt_ids), len(out_ids))
+            tool_calls = None
+            if (constraint_kind == "tool_call"
+                    and handle.finish_reason == "stop"):
+                # the grammar guarantees {"name": …, "arguments": {…}};
+                # re-shape it into the OpenAI tool_calls wire format
+                # (a "length"-truncated call stays raw content — the
+                # client sees exactly what was generated)
+                try:
+                    call = json.loads(text)
+                    tool_calls = [schemas.tool_call_entry(
+                        call["name"],
+                        json.dumps(call["arguments"],
+                                   separators=(",", ":")))]
+                except (ValueError, KeyError, TypeError):
+                    tool_calls = None
             span.end(status=200, finish_reason=handle.finish_reason or "stop",
                      completion_tokens=len(out_ids))
             return send_json(200, schemas.chat_completion_response(
                 req_id=req_id, model=req.model, text=text,
                 finish_reason=handle.finish_reason or "stop", usage=usage,
+                tool_calls=tool_calls,
             ))
         except BaseException as e:
             # a handler exception (kv upload on submit, tokenizer
@@ -693,8 +780,9 @@ class OpenAIServer:
         reg.counter_func("llm_host_gap_seconds_total", _host_gap,
                          "engine-thread seconds between dispatches, by "
                          "host activity (queue_drain/admit/plan/"
-                         "index_build/draft_propose/dispatch_wait/"
-                         "sample_commit/publish/other)")
+                         "index_build/draft_propose/grammar_compile/"
+                         "grammar_mask/dispatch_wait/sample_commit/"
+                         "publish/other)")
         reg.counter_func(
             "llm_step_wall_seconds_total",
             lambda: stp.snapshot()["step_wall_seconds_total"],
@@ -829,6 +917,27 @@ class OpenAIServer:
             # actually run (the gate silently falls back to single-step)
             reg.counter_func("llm_multi_decode_blocks_total",
                              lambda: eng.multi_blocks)
+        # structured output (serve/constrain.py, ISSUE 12): registered
+        # unconditionally — zeros until the first constrained request,
+        # so dashboards and the metric-docs census see one stable set
+        sc = self._structured_counts
+        reg.counter_func(
+            "llm_structured_requests_total",
+            lambda: [({"kind": k}, v) for k, v in sorted(sc.items())],
+            "requests that carried a grammar constraint, by kind "
+            "(json_object / json_schema / tool_call)")
+        reg.counter_func(
+            "llm_grammar_mask_seconds_total",
+            lambda: eng.grammar_mask_seconds_total,
+            "engine-thread seconds staging grammar logit masks "
+            "(includes lazy automaton-state compiles; the steptrace "
+            "grammar_compile/grammar_mask activities split the two)")
+        reg.counter_func(
+            "llm_spec_grammar_rejects_total",
+            lambda: eng.spec_grammar_rejects,
+            "drafted tokens rejected by the grammar during fused "
+            "spec-round mask staging (the on-device acceptance "
+            "cumprod truncates at each)")
         return reg
 
     def metrics_text(self) -> str:

@@ -1,0 +1,149 @@
+"""Trace-replay arrival schedules for the closed-loop serve benches.
+
+ROADMAP item 2(b), first slice: the uniform closed-loop ladders
+(N workers, back-to-back requests) measure steady-state throughput but
+never exercise the shapes real traffic has — bursts, idle gaps, and
+mixed prompt/output lengths arriving TOGETHER. This module synthesizes
+a seeded, replayable arrival trace:
+
+- **Bursty inter-arrivals**: Gamma-distributed gaps with a chosen
+  coefficient of variation (``cv = 1`` is Poisson; ``cv > 1`` is
+  burstier than Poisson — the canonical open-loop burst model). The
+  Gamma shape is ``1/cv²`` and the scale ``mean·cv²``, so the mean
+  inter-arrival time is exact whatever the burstiness.
+- **Mixed lengths**: per-request prompt/output token counts drawn
+  log-uniformly from configured ranges — the short-chat-next-to-long-
+  document mix arxiv 2311.03687's runtime dissection shows dominating
+  mixed-load latency.
+- **Replayability**: everything derives from one ``numpy`` Generator
+  seed; the schedule (and its parameters) embed in the BENCH artifact,
+  so a regression run replays the identical trace.
+
+Used by ``tools/structured_bench.py`` (the BENCH_STRUCTURED artifact)
+and pluggable into the other serve benches; :func:`replay` drives any
+``submit(request) -> handle`` callable at the scheduled offsets from a
+pool of worker threads (open-loop: a late engine does NOT slow the
+arrival clock — queueing shows up as queueing, not as a lighter load).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One scheduled request: offset seconds from trace start, prompt
+    length and output budget in tokens."""
+
+    at_s: float
+    prompt_tokens: int
+    max_tokens: int
+
+
+def synthesize(*, seed: int, n_requests: int, mean_iat_s: float,
+               cv: float = 2.0, prompt_tokens: tuple[int, int] = (8, 64),
+               max_tokens: tuple[int, int] = (8, 64)) -> list[Arrival]:
+    """Seeded bursty trace: Gamma(1/cv², mean·cv²) inter-arrivals plus
+    log-uniform prompt/output lengths. ``cv=1`` degenerates to Poisson;
+    ``cv=0`` to a uniform (closed-ladder-like) clock."""
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    if mean_iat_s < 0:
+        raise ValueError(f"mean_iat_s must be >= 0, got {mean_iat_s}")
+    rng = np.random.default_rng(seed)
+    if cv <= 0 or mean_iat_s == 0:
+        gaps = np.full((n_requests,), mean_iat_s)
+    else:
+        shape = 1.0 / (cv * cv)
+        gaps = rng.gamma(shape, mean_iat_s / shape, size=n_requests)
+    at = np.cumsum(gaps)
+    at -= at[0]  # first request arrives at t=0
+
+    def log_uniform(lo: int, hi: int, size: int) -> np.ndarray:
+        lo, hi = max(1, int(lo)), max(1, int(hi))
+        if hi <= lo:
+            return np.full((size,), lo)
+        return np.exp(rng.uniform(np.log(lo), np.log(hi + 1), size=size)
+                      ).astype(np.int64).clip(lo, hi)
+
+    plens = log_uniform(*prompt_tokens, n_requests)
+    olens = log_uniform(*max_tokens, n_requests)
+    return [Arrival(float(at[i]), int(plens[i]), int(olens[i]))
+            for i in range(n_requests)]
+
+
+def describe(schedule: list[Arrival]) -> dict:
+    """Artifact block: the schedule's realized statistics (the seeded
+    parameters reproduce it; the realized numbers make drift visible)."""
+    gaps = np.diff([a.at_s for a in schedule]) if len(schedule) > 1 else (
+        np.zeros((1,)))
+    return {
+        "n_requests": len(schedule),
+        "span_s": round(schedule[-1].at_s, 4) if schedule else 0.0,
+        "iat_mean_s": round(float(np.mean(gaps)), 5),
+        "iat_cv": round(float(np.std(gaps) / np.mean(gaps)), 3)
+        if float(np.mean(gaps)) > 0 else 0.0,
+        "prompt_tokens_mean": round(float(np.mean(
+            [a.prompt_tokens for a in schedule])), 1),
+        "max_tokens_mean": round(float(np.mean(
+            [a.max_tokens for a in schedule])), 1),
+    }
+
+
+def replay(schedule: list[Arrival], submit, *, workers: int = 8,
+           time_scale: float = 1.0, lateness: list | None = None) -> list:
+    """Open-loop replay: fire ``submit(arrival)`` at each arrival's
+    scheduled offset (scaled by ``time_scale``) from a worker pool, and
+    return the submit results in schedule order.
+
+    Open-loop holds only while in-flight requests fit the pool: callers
+    that BLOCK inside ``submit`` (drain the stream) bound concurrency
+    at ``workers``, and arrivals past that fire LATE — a degradation
+    toward closed-loop that must be visible, not assumed away. Pass a
+    ``lateness`` list to receive each arrival's realized (start − due)
+    seconds in schedule order; the benches embed its p99/max so an
+    artifact states the load actually applied, not just the schedule.
+    """
+    results: list = [None] * len(schedule)
+    late: list = [0.0] * len(schedule)
+    idx_lock = threading.Lock()
+    next_idx = [0]
+    t0 = time.monotonic()
+
+    def worker():
+        while True:
+            with idx_lock:
+                i = next_idx[0]
+                if i >= len(schedule):
+                    return
+                next_idx[0] += 1
+            due = t0 + schedule[i].at_s * time_scale
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            late[i] = max(0.0, time.monotonic() - due)
+            results[i] = submit(schedule[i])
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(max(1, workers))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if lateness is not None:
+        lateness.extend(late)
+    return results
+
+
+def lateness_stats(lateness: list) -> dict:
+    """Artifact block for a replay's realized arrival lateness."""
+    arr = np.asarray(lateness if lateness else [0.0])
+    return {
+        "arrival_lateness_p99_s": round(float(np.percentile(arr, 99)), 4),
+        "arrival_lateness_max_s": round(float(arr.max()), 4),
+    }

@@ -403,6 +403,16 @@ class ResponseCache:
             for m in body.get("messages", [])
         )
 
+    @staticmethod
+    def _structured(body: dict) -> bool:
+        """Structured-output requests (ISSUE 12 gateway passthrough):
+        `response_format`/`tools` forward untouched, but the SEMANTIC
+        tier matches on conversation text alone — it could hand a
+        schema-constrained request a cached free-text answer. Exact
+        hits are safe (the key hashes every non-transport field)."""
+        return bool(body.get("response_format") or body.get("tools")
+                    or body.get("tool_choice"))
+
     def get(self, body: dict) -> dict | None:
         if body.get("stream"):
             return None
@@ -416,7 +426,7 @@ class ResponseCache:
             if hit and now - hit[0] < self.ttl_s:
                 self.hits += 1
                 return hit[1]
-            if self.semantic_threshold is None:
+            if self.semantic_threshold is None or self._structured(body):
                 self.misses += 1
                 return None
         # Embed OUTSIDE the lock (may be a remote /v1/embeddings call).
@@ -441,9 +451,12 @@ class ResponseCache:
             return
         now = time.time()
         key = self._key(body)
-        # Embed before taking the lock — see get() for why.
+        # Embed before taking the lock — see get() for why. Structured
+        # responses never enter the semantic tier (their text answers a
+        # schema, not just the conversation — see _structured).
         emb = (self._embed(self._conversation_text(body))
-               if self.semantic_threshold is not None else None)
+               if self.semantic_threshold is not None
+               and not self._structured(body) else None)
         with self._lock:
             self._exact[key] = (now, response)
             if len(self._exact) > self.max_entries:

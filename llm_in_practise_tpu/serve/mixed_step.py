@@ -67,12 +67,25 @@ def pin_index(cache, index_vec):
 
 
 def decode_scan(model, params, cache, tokens, rng, temperature, top_k,
-                top_p, greedy, *, n):
+                top_p, greedy, *, n, gmask=None):
     """``n`` single-token decodes under one ``lax.scan`` — the SHARED
     body of the sequential multi-step program
     (``engine._decode_multi_fn``) and the fused mixed step, so the two
     dispatch modes can never drift apart in sampling or key-split
-    order. Returns ``((B, n) tokens, cache)``."""
+    order. Returns ``((B, n) tokens, cache)``.
+
+    ``gmask`` (optional, (B, vocab) additive): the grammar logit mask
+    of constrained decoding (serve/constrain.py) — 0 for allowed
+    tokens, ``NEG_INF`` otherwise, zero rows for unconstrained slots.
+    The SAME mask applies at every scan step, which is only correct for
+    ``n == 1`` (the grammar state advances per token); the engine's
+    planner caps constrained blocks at 1, and the unmasked programs
+    (``gmask=None``) stay compiled-identical to pre-constraint builds.
+    """
+    if gmask is not None and n != 1:
+        raise ValueError(
+            f"grammar-masked decode blocks must be n=1, got n={n} "
+            "(the per-slot mask is staged for one automaton state)")
 
     def body(carry, key):
         tok, c = carry
@@ -80,8 +93,11 @@ def decode_scan(model, params, cache, tokens, rng, temperature, top_k,
             {"params": params}, tok[:, None], deterministic=True,
             cache=c,
         )
+        logits = lg[:, -1, :].astype(jnp.float32)
+        if gmask is not None:
+            logits = logits + gmask
         nxt = sample_token_batched(
-            key, lg[:, -1, :].astype(jnp.float32),
+            key, logits,
             temperature=temperature, top_k=top_k, top_p=top_p,
             greedy=greedy,
         ).astype(jnp.int32)
@@ -109,7 +125,8 @@ def batched_chunk(model, params, cache, chunk_ids, starts, lens):
     return last, cache
 
 
-def spec_verify_block(model, params, cache, tokens, base, mask, *, m):
+def spec_verify_block(model, params, cache, tokens, base, mask, *, m,
+                      gmasks=None):
     """Fused speculative round: verify the K drafted tokens AND run the
     remainder of the planned decode block, in ONE jitted dispatch
     (ROADMAP item 4 — "verify k proposed tokens inside the n-step
@@ -147,6 +164,17 @@ def spec_verify_block(model, params, cache, tokens, base, mask, *, m):
     Greedy-lossless: every emitted token — accepted, bonus, or
     extension — is an argmax of this program's own forward, identical
     to what the sequential greedy path emits.
+
+    ``gmasks`` (optional, (B, K+1, vocab) additive): grammar logit
+    masks for constrained decoding — position ``j``'s row is the mask
+    of the automaton state after the first ``j`` drafts (the host
+    advances the grammar tentatively over the drafted tokens,
+    serve/engine._try_speculative). A grammar-forbidden draft cannot be
+    the masked argmax at its position, so the acceptance cumprod
+    truncates there exactly like an argmax mismatch, and the bonus
+    token at ``n_acc`` is masked by the right state's row. The caller
+    runs constrained rounds at ``m == 0`` (the extension's scan steps
+    have no host-stageable mask).
     """
     base = base.astype(jnp.int32)
     mask = mask.astype(jnp.int32)
@@ -154,7 +182,10 @@ def spec_verify_block(model, params, cache, tokens, base, mask, *, m):
         {"params": params}, tokens, deterministic=True,
         cache=pin_index(cache, base),
     )
-    out = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32)
+    if gmasks is not None:
+        logits = logits + gmasks
+    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     # longest accepted prefix: position j is accepted iff every draft
     # up to and including j matched the model's own output
     match = (out[:, :-1] == tokens[:, 1:]).astype(jnp.int32)   # (B, K)
@@ -263,6 +294,30 @@ def make_mixed_step(model):
         return chunk_last, toks, cache                       # (B, n)
 
     return mixed_step_fn
+
+
+def make_masked_mixed_step(model):
+    """Grammar-masked twin of :func:`make_mixed_step`: identical body
+    plus a trailing ``gmask`` (max_slots, vocab) additive logit mask
+    applied to the decode half (serve/constrain.py). A SEPARATE
+    compiled program, not a flag on the unmasked one — unconstrained
+    steps keep the exact pre-constraint program (golden parity by
+    construction) and never pay the mask's host→device transfer. The
+    planner caps constrained blocks at ``n == 1`` (the mask encodes one
+    automaton state per slot)."""
+
+    def masked_mixed_step_fn(params, cache, chunk_ids, starts, lens,
+                             advance, tokens, rng, temperature, top_k,
+                             top_p, greedy, gmask, *, n):
+        chunk_last, cache = batched_chunk(
+            model, params, cache, chunk_ids, starts, lens)
+        toks, cache = decode_scan(
+            model, params, cache, tokens, rng, temperature, top_k,
+            top_p, greedy, n=n, gmask=gmask)
+        cache = pin_index(cache, starts + lens + advance)
+        return chunk_last, toks, cache                       # (B, n)
+
+    return masked_mixed_step_fn
 
 
 def plan_decode_block(*, decode_steps: int, queue_depth: int,

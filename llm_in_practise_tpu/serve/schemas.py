@@ -38,11 +38,70 @@ class ChatMessage:
         return cls(role, content)
 
 
+def _validate_response_format(rf: Any):
+    """Shape-check OpenAI ``response_format`` (semantic schema support
+    is the constrain compiler's job — serve/constrain.py)."""
+    if rf is None:
+        return None
+    if not isinstance(rf, dict):
+        raise ValidationError("'response_format' must be an object")
+    rf_type = rf.get("type")
+    if rf_type not in ("text", "json_object", "json_schema"):
+        raise ValidationError(
+            "response_format.type must be 'text', 'json_object', or "
+            f"'json_schema', got {rf_type!r}")
+    if rf_type == "json_schema":
+        wrapper = rf.get("json_schema")
+        if not isinstance(wrapper, dict) or not isinstance(
+                wrapper.get("schema"), dict):
+            raise ValidationError(
+                "response_format.json_schema.schema must be an object")
+    return rf
+
+
+def _validate_tools(tools: Any, tool_choice: Any):
+    """Shape-check OpenAI ``tools`` / ``tool_choice``."""
+    if tools is not None:
+        if not isinstance(tools, list):
+            raise ValidationError("'tools' must be an array")
+        for t in tools:
+            if (not isinstance(t, dict) or t.get("type") != "function"
+                    or not isinstance(t.get("function"), dict)
+                    or not isinstance(t["function"].get("name"), str)):
+                raise ValidationError(
+                    "each tool must be {'type': 'function', 'function': "
+                    "{'name': …, 'parameters': …}}")
+    if tool_choice is None:
+        return tools, None
+    if isinstance(tool_choice, str):
+        if tool_choice not in ("auto", "none", "required"):
+            raise ValidationError(
+                "tool_choice must be 'auto', 'none', 'required', or a "
+                "function reference")
+    elif isinstance(tool_choice, dict):
+        if (tool_choice.get("type") != "function"
+                or not isinstance(tool_choice.get("function"), dict)
+                or not isinstance(
+                    tool_choice["function"].get("name"), str)):
+            raise ValidationError(
+                "tool_choice object must be {'type': 'function', "
+                "'function': {'name': …}}")
+    else:
+        raise ValidationError("tool_choice must be a string or object")
+    if tool_choice not in ("auto", "none") and not tools:
+        raise ValidationError(
+            f"tool_choice {tool_choice!r} requires a non-empty 'tools'")
+    return tools, tool_choice
+
+
 @dataclasses.dataclass
 class ChatCompletionRequest:
     """Request body of POST /v1/chat/completions (the fields the reference
     server accepts: model, messages, max_tokens, temperature, top_p, stream —
-    ``07-…-api-infr.py:95-102`` — plus top_k and greedy-mode seed parity)."""
+    ``07-…-api-infr.py:95-102`` — plus top_k and greedy-mode seed parity,
+    plus the structured-output surface: ``response_format`` and
+    ``tools``/``tool_choice``, enforced by grammar-compiled logit masks
+    — serve/constrain.py, docs/structured-output.md)."""
 
     model: str
     messages: list[ChatMessage]
@@ -51,6 +110,9 @@ class ChatCompletionRequest:
     top_p: float = 1.0
     top_k: int = 0
     stream: bool = False
+    response_format: dict | None = None
+    tools: list | None = None
+    tool_choice: Any = None
 
     @classmethod
     def from_dict(cls, d: Any) -> "ChatCompletionRequest":
@@ -72,6 +134,8 @@ class ChatCompletionRequest:
                 raise ValidationError(f"'{key}' must be in [{lo}, {hi}]")
             return v
 
+        tools, tool_choice = _validate_tools(
+            d.get("tools"), d.get("tool_choice"))
         return cls(
             model=d["model"],
             messages=msgs,
@@ -80,6 +144,10 @@ class ChatCompletionRequest:
             top_p=num("top_p", 1.0, 0.0, 1.0),
             top_k=num("top_k", 0, 0, 1 << 20, int),
             stream=bool(d.get("stream", False)),
+            response_format=_validate_response_format(
+                d.get("response_format")),
+            tools=tools,
+            tool_choice=tool_choice,
         )
 
 
@@ -103,8 +171,17 @@ def completion_id() -> str:
 
 
 def chat_completion_response(
-    *, req_id: str, model: str, text: str, finish_reason: str, usage: Usage
+    *, req_id: str, model: str, text: str, finish_reason: str, usage: Usage,
+    tool_calls: list | None = None,
 ) -> dict:
+    """``tool_calls`` (forced tool-choice requests): the parsed calls
+    replace ``content`` and the finish reason becomes ``tool_calls``,
+    matching the OpenAI wire shape."""
+    message: dict = {"role": "assistant", "content": text}
+    if tool_calls is not None:
+        message = {"role": "assistant", "content": None,
+                   "tool_calls": tool_calls}
+        finish_reason = "tool_calls"
     return {
         "id": req_id,
         "object": "chat.completion",
@@ -113,11 +190,21 @@ def chat_completion_response(
         "choices": [
             {
                 "index": 0,
-                "message": {"role": "assistant", "content": text},
+                "message": message,
                 "finish_reason": finish_reason,
             }
         ],
         "usage": usage.to_dict(),
+    }
+
+
+def tool_call_entry(name: str, arguments: str) -> dict:
+    """One message.tool_calls[] entry (``arguments`` is the JSON TEXT,
+    per the OpenAI wire format)."""
+    return {
+        "id": "call_" + uuid.uuid4().hex[:24],
+        "type": "function",
+        "function": {"name": name, "arguments": arguments},
     }
 
 

@@ -168,6 +168,25 @@ def main() -> None:
         inproc_levels.append(r)
         print(json.dumps(r), flush=True)
 
+    # trace-replay row (ISSUE 12 satellite / ROADMAP 2b first slice):
+    # the SAME engine under a seeded bursty open-loop schedule with
+    # mixed prompt/output lengths — realistic-load numbers next to the
+    # uniform ladder, same row shape (serve/arrivals.py)
+    from deploy.benchmark.bench_serve import run_trace_inprocess
+    from llm_in_practise_tpu.serve import arrivals
+
+    sched = arrivals.synthesize(
+        seed=42, n_requests=_requests_for(64), mean_iat_s=0.05, cv=2.0,
+        prompt_tokens=(16, 192), max_tokens=(16, MAX_TOKENS))
+    r = run_trace_inprocess(engine, prompt_ids, sched)
+    # success_rate guards the gate: with zero served requests the
+    # percentiles are vacuous 0.0 and must not read as an SLA pass
+    r["sla_ok"] = (r["success_rate"] > 0.5
+                   and r["ttft_p99_ms"] < SLA["ttft_p99_ms"]
+                   and r["tpot_p99_ms"] < SLA["tpot_p99_ms"])
+    inproc_levels.append(r)
+    print(json.dumps(r), flush=True)
+
     srv = OpenAIServer(engine, tok, model_name="gptlike-tpu")
     port = srv.serve(host="127.0.0.1", port=0, background=True)
     url = f"http://127.0.0.1:{port}"
