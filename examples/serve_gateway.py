@@ -89,6 +89,23 @@ def main():
                    metavar="SECONDS",
                    help="SLO goodput: per-token (TPOT) threshold "
                         "(docs/observability.md device plane)")
+    p.add_argument("--tenant-quota", dest="tenant_quota", action="append",
+                   default=[], metavar="TENANT=TOKENS",
+                   help="repeatable: per-tenant token-bucket quota, keyed "
+                        "on the request's model name (adapter tenants from "
+                        "--lora-modules upstreams). Actual completion "
+                        "tokens are debited post-response; an overdrawn "
+                        "bucket 429s until it refills "
+                        "(gateway_tenant_quota_balance)")
+    p.add_argument("--tenant-weight", dest="tenant_weight", action="append",
+                   default=[], metavar="TENANT=WEIGHT",
+                   help="repeatable: fairness weight multiplying a "
+                        "tenant's bucket capacity AND refill rate "
+                        "(proportional share, default 1.0)")
+    p.add_argument("--tenant-quota-window", dest="tenant_quota_window",
+                   type=float, default=60.0, metavar="SECONDS",
+                   help="token buckets refill their full capacity over "
+                        "this window")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=4000)
     args = p.parse_args()
@@ -131,6 +148,24 @@ def main():
         thr = args.semantic_threshold if args.semantic_threshold > 0 else None
         cache = ResponseCache(ttl_s=args.cache_ttl, semantic_threshold=thr)
 
+    def _kv_floats(specs, flag):
+        out = {}
+        for spec in specs:
+            name, sep, val = spec.partition("=")
+            try:
+                if not sep or not name:
+                    raise ValueError(spec)
+                out[name] = float(val)
+            except ValueError:
+                p.error(f"invalid {flag} {spec!r} (want TENANT=NUMBER)")
+        return out
+
+    tenant_quotas = _kv_floats(args.tenant_quota, "--tenant-quota")
+    tenant_weights = _kv_floats(args.tenant_weight, "--tenant-weight")
+    for t in tenant_weights:
+        if t not in tenant_quotas:
+            p.error(f"--tenant-weight {t!r} has no matching --tenant-quota")
+
     router_cls = {
         "prefix_aware": PrefixAffinityRouter,
         "disagg": DisaggRouter,
@@ -143,6 +178,9 @@ def main():
         moderation=gateway_hook(ModerationService()) if args.moderation else None,
         ttft_slo_s=args.ttft_slo,
         tpot_slo_s=args.tpot_slo,
+        tenant_quotas=tenant_quotas or None,
+        tenant_weights=tenant_weights or None,
+        tenant_quota_window_s=args.tenant_quota_window,
     )
     scalers = []
     if args.autoscale:
@@ -184,6 +222,10 @@ def main():
     for u in upstreams:
         tag = "" if u.role == "both" else f", role {u.role}"
         print(f"upstream {u.group}: {u.base_url} (weight {u.weight}{tag})")
+    for t, q in sorted(tenant_quotas.items()):
+        w = tenant_weights.get(t, 1.0)
+        print(f"tenant {t}: {q * w:g} tokens / "
+              f"{args.tenant_quota_window:g}s (weight {w:g})")
     print(f"gateway on {args.host}:{args.port} "
           f"(/v1/chat/completions, /health, /metrics, /debug/traces)")
     try:

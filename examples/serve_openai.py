@@ -66,6 +66,28 @@ def validate_args(args, error) -> None:
               "adapters merge by unrolled block_i/... kernel paths, "
               "which do not exist in the stacked tree (they would "
               "silently serve base weights)")
+    if args.lora_modules:
+        # fail fast at the CLI — a typo'd spec or missing checkpoint
+        # should not surface as a traceback after the (slow) base
+        # checkpoint restore (ISSUE 15 registry wiring)
+        import os as _os
+
+        from llm_in_practise_tpu.serve.adapters import parse_lora_modules
+
+        try:
+            modules = parse_lora_modules(args.lora_modules)
+        except ValueError as e:
+            error(f"--lora-modules: {e}")
+        for name, path in modules.items():
+            if name == getattr(args, "model_name", None):
+                error(f"--lora-modules: adapter name {name!r} collides "
+                      "with --model_name (the base model's served name)")
+            ckpt_file = (_os.path.join(path, "adapter.msgpack")
+                         if _os.path.isdir(path) else path)
+            if not _os.path.exists(ckpt_file):
+                error(f"--lora-modules {name}: no adapter checkpoint "
+                      f"at {path} (want adapter.msgpack + sidecar from "
+                      "ckpt.save_named)")
     if args.role != "both" and not args.kv_remote:
         error(f"--role {args.role} requires --kv-remote: the KV handoff "
               "between the prefill and decode pools travels through the "
@@ -404,15 +426,40 @@ def main():
         kv_page_size=args.kv_page_size,
         kv_pool_tokens=args.kv_pool_tokens,
     )
+    # batched multi-LoRA (ISSUE 15): adapters ride the BASE engine's
+    # fused dispatch through an AdapterRegistry — one base-weight copy,
+    # mixed-adapter slots in one step. The legacy engine-per-adapter
+    # path remains only for tiered/remote KV setups, where each served
+    # model needs its own pool + handoff namespace (one weight set per
+    # engine); build_adapter_engines warns when it takes it.
+    lora_modules = {}
+    adapter_registry = None
+    if args.lora_modules:
+        from llm_in_practise_tpu.serve.adapters import parse_lora_modules
+
+        lora_modules = parse_lora_modules(args.lora_modules)
+        if not (args.kv_offload or args.kv_remote):
+            from llm_in_practise_tpu.serve.multi_lora import AdapterRegistry
+
+            adapter_registry = AdapterRegistry(params, mesh=mesh)
     engine = InferenceEngine(model, params,
                              kv_pool=make_kv_pool(args.model_name),
                              role=args.role, handoff=handoff,
+                             adapter_registry=adapter_registry,
                              **engine_kw)
     adapters = {}
-    if args.lora_modules:
+    if lora_modules and adapter_registry is not None:
+        from llm_in_practise_tpu.serve.multi_lora import AdapterHandle
+
+        for name, path in lora_modules.items():
+            adapter_registry.register(name, path)
+        adapters = {name: AdapterHandle(engine, name)
+                    for name in lora_modules}
+        print(f"adapters (batched multi-LoRA, one shared engine): "
+              f"{sorted(adapters)}")
+    elif lora_modules:
         from llm_in_practise_tpu.serve.adapters import (
             build_adapter_engines,
-            parse_lora_modules,
         )
 
         # adapter engines skip the draft: the draft approximates the
@@ -420,7 +467,7 @@ def main():
         adapter_kw = {k: v for k, v in engine_kw.items()
                       if not k.startswith("draft_")}
         adapters = build_adapter_engines(
-            model, params, parse_lora_modules(args.lora_modules),
+            model, params, lora_modules,
             param_transform=shard_fn,
             # per-model tiers AND per-model handoff namespace: adapter
             # requests disaggregate exactly like the base model's

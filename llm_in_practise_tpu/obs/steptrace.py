@@ -53,6 +53,9 @@ Activity glossary (docs/observability.md "Host timeline"):
                    (serve/constrain.py, ISSUE 12)
 ``grammar_mask``   per-step staging of the grammar logit masks for
                    constrained slots (compiled-state lookups + array fill)
+``adapter_gather`` per-dispatch assembly of the multi-LoRA bank args —
+                   slot→row index build + bank snapshot handoff
+                   (serve/multi_lora.py, ISSUE 15)
 ``dispatch_wait``  jitted-dispatch windows net of the device-booked time
 ``sample_commit``  per-token commit/emit loops + prefill finalization
 ``publish``        handoff entry gather/queue on the engine thread
@@ -69,7 +72,8 @@ from collections import deque
 
 ACTIVITIES = ("queue_drain", "admit", "plan", "index_build",
               "draft_propose", "grammar_compile", "grammar_mask",
-              "dispatch_wait", "sample_commit", "publish", "other")
+              "adapter_gather", "dispatch_wait", "sample_commit",
+              "publish", "other")
 
 # synthetic Chrome-trace thread ids for the dual-lane view; request
 # spans use real thread idents (< 2^31), so these can't collide

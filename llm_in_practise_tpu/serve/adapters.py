@@ -5,11 +5,18 @@ The reference serves fine-tuned adapters with
 (``Fine-Tuning/README.md:340-361``): one base model, extra model names
 backed by LoRA deltas, selected per request via the OpenAI ``model`` field.
 
-Here each adapter name maps to an :class:`InferenceEngine` whose params are
-the base with the adapter folded in (merge at load — on TPU the merged
-matmul is strictly cheaper than per-request delta application, and slots
-inside one engine batch share weights). Adapters are the ``adapter.msgpack``
-+ ``adapter.json`` pairs written by ``examples/qwen3_lora_sft.py`` /
+Since ISSUE 15 this module is a thin compatibility shim over
+``serve/multi_lora.py``: :func:`build_adapter_engines` builds ONE shared
+:class:`InferenceEngine` with an :class:`~.multi_lora.AdapterRegistry`
+and returns engine-shaped :class:`~.multi_lora.AdapterHandle` views, so
+every adapter rides the same fused dispatch and the base weights live in
+HBM exactly once. The legacy engine-per-adapter merged-weight path is
+kept (with a warning) only for the cases the batched-BGMV twins cannot
+serve: scan-layers models (stacked cache layout, no per-block module
+paths for the interceptor) and callers passing per-adapter engine
+kwargs (``engine_kw_for`` — separate kv pools / handoff namespaces imply
+separate weight sets). Adapters are the ``adapter.msgpack`` +
+``adapter.json`` pairs written by ``examples/qwen3_lora_sft.py`` /
 ``ckpt.save_named``.
 """
 
@@ -18,8 +25,11 @@ from __future__ import annotations
 import os
 
 from llm_in_practise_tpu.ckpt import checkpoint as ckpt_lib
+from llm_in_practise_tpu.obs.logging import get_logger
 from llm_in_practise_tpu.peft import LoRAConfig, merge_lora
 from llm_in_practise_tpu.serve.engine import InferenceEngine
+
+_log = get_logger("serve.adapters")
 
 
 def parse_lora_modules(specs: list[str]) -> dict[str, str]:
@@ -53,26 +63,63 @@ def build_adapter_engines(
     param_transform=None,
     engine_kw_for=None,
     **engine_kw,
-) -> dict[str, InferenceEngine]:
-    """One engine per adapter name, merged weights, shared model/config.
+):
+    """Adapter-name → engine-shaped handle map for ``OpenAIServer``.
 
-    ``param_transform`` (optional) post-processes each adapter's merged
-    params — e.g. :func:`..serve.engine.shard_params_for_serving` so
-    adapters follow the base engine's tensor-parallel placement instead of
+    Default (registry) path: ONE shared :class:`InferenceEngine` carrying
+    an :class:`~.multi_lora.AdapterRegistry`; each name maps to an
+    :class:`~.multi_lora.AdapterHandle` that pins its adapter on
+    ``submit``. Mixed-adapter slots batch into the same fused dispatch
+    and base HBM is paid once regardless of the adapter count.
+
+    Legacy (merged-weight engine-per-adapter) fallback, warned:
+
+    - scan-layers models (``cache_slot_axis == 1``): the stacked scan
+      body has no per-block module paths for the LoRA interceptor, so
+      the adapter merges into the stacked kernels instead
+    - ``engine_kw_for`` given: per-adapter kwargs (kv pools, handoff
+      namespaces) assume one weight set per engine
+
+    ``param_transform`` (optional) post-processes the params handed to
+    each built engine — e.g. :func:`..serve.engine.shard_params_for_serving`
+    so they follow the base engine's tensor-parallel placement instead of
     replicating host arrays onto every mesh device.
 
-    ``engine_kw_for(name)`` (optional) returns per-adapter kwargs merged
-    over ``engine_kw`` — needed for anything that must NOT be shared
-    across weight sets, like a ``kv_pool`` (each adapter's KV is only
-    valid under its own merged weights).
+    ``engine_kw_for(name)`` (optional, legacy-only) returns per-adapter
+    kwargs merged over ``engine_kw``.
     """
-    def prep(path):
-        merged = load_adapter(base_params, path)
-        return param_transform(merged) if param_transform else merged
+    scan_layers = int(getattr(model, "cache_slot_axis", 0)) == 1
+    if scan_layers or engine_kw_for is not None:
+        why = ("scan-layers model serves contiguous stacked kernels"
+               if scan_layers else "per-adapter engine kwargs requested")
+        _log.warning(
+            "legacy engine-per-adapter path (%s): each of the %d "
+            "adapter(s) pays full base-model HBM — the batched "
+            "multi-LoRA registry (serve/multi_lora.py) shares one "
+            "engine across adapters", why, len(modules))
 
-    return {
-        name: InferenceEngine(
-            model, prep(path),
-            **{**engine_kw, **(engine_kw_for(name) if engine_kw_for else {})})
-        for name, path in modules.items()
-    }
+        def prep(path):
+            merged = load_adapter(base_params, path)
+            return param_transform(merged) if param_transform else merged
+
+        return {
+            name: InferenceEngine(
+                model, prep(path),
+                **{**engine_kw,
+                   **(engine_kw_for(name) if engine_kw_for else {})})
+            for name, path in modules.items()
+        }
+
+    from llm_in_practise_tpu.serve.multi_lora import (
+        AdapterHandle,
+        AdapterRegistry,
+    )
+
+    registry = AdapterRegistry(base_params, mesh=engine_kw.get("mesh"))
+    params = (param_transform(base_params) if param_transform
+              else base_params)
+    engine = InferenceEngine(model, params, adapter_registry=registry,
+                             **engine_kw)
+    for name, path in modules.items():
+        registry.register(name, path)
+    return {name: AdapterHandle(engine, name) for name in modules}

@@ -938,6 +938,54 @@ class OpenAIServer:
             "drafted tokens rejected by the grammar during fused "
             "spec-round mask staging (the on-device acceptance "
             "cumprod truncates at each)")
+        # multi-LoRA plane (serve/multi_lora.py, ISSUE 15): read the
+        # adapter registries LIVE at scrape — the base engine's (when it
+        # serves adapters) plus any distinct registry behind the
+        # adapters= handles (the build_adapter_engines shim's shared
+        # engine). Registered unconditionally; no registry → families
+        # present, no samples.
+        def _adapter_regs():
+            seen = {}
+            for e in (eng, *self.adapters.values()):
+                r = getattr(e, "adapter_registry", None)
+                if r is not None:
+                    seen[id(r)] = r
+            return list(seen.values())
+
+        def _adapter_sum(key):
+            def read():
+                regs = _adapter_regs()
+                if not regs:
+                    return []
+                return [({}, sum(r.stats()[key] for r in regs))]
+            return read
+
+        reg.gauge_func("llm_adapters_loaded", _adapter_sum("loaded"),
+                       "LoRA adapters resident in the registry banks")
+        reg.gauge_func("llm_adapter_bytes", _adapter_sum("bytes_loaded"),
+                       "HBM bytes held by loaded adapter factor rows "
+                       "(f32 payload at the padded bucket rank)")
+        reg.counter_func("llm_adapter_swap_seconds_total",
+                         _adapter_sum("swap_seconds_total"),
+                         "cumulative seconds spent hot-loading adapter "
+                         "checkpoints into the banks")
+        reg.counter_func("llm_adapter_evictions_total",
+                         _adapter_sum("evictions_total"),
+                         "adapter rows evicted under the registry byte "
+                         "budget (refcount-0 LRU only)")
+
+        def _tenant_tokens():
+            out: dict[str, int] = {}
+            for r in _adapter_regs():
+                for name, n in r.stats()["tenant_tokens"].items():
+                    out[name] = out.get(name, 0) + n
+            return [({"adapter": name}, n)
+                    for name, n in sorted(out.items())]
+
+        reg.counter_func("llm_tenant_tokens_total", _tenant_tokens,
+                         "output tokens generated per adapter tenant "
+                         "(finished requests; base-model traffic is "
+                         "not labeled)")
         return reg
 
     def metrics_text(self) -> str:

@@ -185,6 +185,18 @@ class Request:
     # guarantee extends to constrained requests).
     constraint_state: object | None = dataclasses.field(
         default=None, repr=False, compare=False)
+    # batched multi-LoRA (serve/multi_lora.py, ISSUE 15): the adapter
+    # name this request decodes under (None = base model). Admission
+    # stamps it into ``slot_adapter``; the registry holds a refcount
+    # from submit until _record_finished so the adapter can't be
+    # evicted mid-request. Rides preempt-by-recompute requeues — the
+    # ref stays held, the resumed slot re-stamps the same adapter.
+    adapter: str | None = None
+    # True while this request holds a registry refcount (set by submit,
+    # cleared by _record_finished) — release must never run for a
+    # request whose acquire never did (the too_large fast-reject)
+    adapter_ref: bool = dataclasses.field(
+        default=False, repr=False, compare=False)
 
     def cp_add(self, seg: str, dt: float) -> None:
         """Accumulate ``dt`` seconds into critical-path segment ``seg``.
@@ -357,6 +369,7 @@ class InferenceEngine:
         kv_page_size: int = 16,
         kv_pool_tokens: int | None = None,
         steptrace: StepTrace | None = None,
+        adapter_registry=None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -367,6 +380,20 @@ class InferenceEngine:
         )
 
         enable_compilation_cache()
+        # Batched multi-LoRA (serve/multi_lora.py, ISSUE 15): wrap the
+        # model in the gathered-BGMV facade BEFORE anything below closes
+        # over it (mixed-step builders, PagedKV, init_cache, the cost
+        # model all take the LOCAL ``model``). The facade delegates
+        # untouched while no lora context is set, so every base program
+        # traces the exact pre-LoRA computation; only the *_lora twins
+        # push a context.
+        self.adapter_registry = adapter_registry
+        if adapter_registry is not None:
+            from llm_in_practise_tpu.serve.multi_lora import (
+                LoRAServingModel,
+            )
+
+            model = LoRAServingModel(model)
         self.model = model
         self.params = params
         # Cache layout: which axis of each KV buffer indexes the slot.
@@ -489,6 +516,10 @@ class InferenceEngine:
         # programs apply it in-dispatch — 1 dispatch/step holds with
         # grammar on, on both KV layouts. Engine-thread only.
         self.slot_constraint: list = [None] * max_slots
+        # Per-slot adapter name (multi-LoRA, ISSUE 15; None = base).
+        # Engine-thread only; joins the dispatch plan as the gathered
+        # row-index array the *_lora twins consume.
+        self.slot_adapter: list[str | None] = [None] * max_slots
         # lifetime grammar telemetry (engine-thread writes, scrape-side
         # monotone-float reads — the collective_* counter convention):
         # llm_grammar_mask_seconds_total / llm_spec_grammar_rejects_total
@@ -791,7 +822,7 @@ class InferenceEngine:
         )
 
         self.tp_quantized_collectives = isinstance(
-            model, TPQuantizedCollectives)
+            getattr(model, "inner", model), TPQuantizedCollectives)
 
         # Dispatch accounting: every jitted engine program is wrapped so
         # /metrics (llm_dispatches_*) and the mixed-step tests can assert
@@ -884,6 +915,73 @@ class InferenceEngine:
             self._draft_roll = _c(jax.jit(self._draft_roll_fn,
                                           donate_argnums=(1,),
                                           static_argnames=("k",)))
+        if adapter_registry is not None:
+            # Adapter twins (serve/multi_lora.py, ISSUE 15 — the
+            # grammar-masked-twin economics): SEPARATE compiled programs
+            # taking a KW-ONLY ``lora`` pytree (per-row bank indices +
+            # the stacked A/B factor banks) pushed as the thread-local
+            # lora context INSIDE the traced body, so the facade's
+            # interceptor adds the gathered low-rank delta on the LoRA
+            # target matmuls. Keyword-only keeps every positional
+            # donate_argnums index valid; jit laziness means a step
+            # whose rows are all base runs the base executable and the
+            # twin never compiles. Draft programs deliberately have NO
+            # twins — drafts stay base-model (ISSUE 15) and rejected
+            # drafts cost nothing; the verify dispatch IS the target
+            # forward, so the spec twins below carry the delta.
+            from llm_in_practise_tpu.serve.multi_lora import lora_wrap
+
+            self._decode_lora = _c(jax.jit(
+                lora_wrap(self._decode_fn), donate_argnums=(1,)))
+            self._decode_multi_lora = _c(jax.jit(
+                lora_wrap(self._decode_multi_fn), donate_argnums=(1,),
+                static_argnames=("n",)))
+            self._decode_spec_lora = _c(jax.jit(
+                lora_wrap(self._decode_spec_fn), donate_argnums=(1,),
+                static_argnames=("m",)))
+            self._prefill_lora = _c(jax.jit(
+                lora_wrap(self._prefill_fn)))
+            self._prefill_suffix_lora = _c(jax.jit(
+                lora_wrap(self._prefill_suffix_fn)))
+            self._chunk_slot_lora = _c(jax.jit(
+                lora_wrap(self._chunk_slot_fn), donate_argnums=(1,)))
+            self._chunk_batch_lora = _c(jax.jit(
+                lora_wrap(self._chunk_batch_fn), donate_argnums=(1,)))
+            self._mixed_lora = _c(jax.jit(
+                lora_wrap(self._mixed_raw), donate_argnums=(1,),
+                static_argnames=("n",)))
+            self._decode_masked_lora = _c(jax.jit(
+                lora_wrap(self._decode_masked_fn), donate_argnums=(1,)))
+            self._decode_spec_masked_lora = _c(jax.jit(
+                lora_wrap(self._decode_spec_masked_fn),
+                donate_argnums=(1,), static_argnames=("m",)))
+            self._mixed_masked_lora = _c(jax.jit(
+                lora_wrap(self._mixed_masked_raw), donate_argnums=(1,),
+                static_argnames=("n",)))
+            if self.paged is not None:
+                self._pg_decode_lora = _c(jax.jit(
+                    lora_wrap(self._paged_decode_fn),
+                    donate_argnums=(1,)))
+                self._pg_multi_lora = _c(jax.jit(
+                    lora_wrap(self._paged_multi_fn), donate_argnums=(1,),
+                    static_argnames=("n",)))
+                self._pg_spec_lora = _c(jax.jit(
+                    lora_wrap(self._paged_spec_fn), donate_argnums=(1,),
+                    static_argnames=("m",)))
+                self._pg_chunk_lora = _c(jax.jit(
+                    lora_wrap(self._paged_chunk_fn), donate_argnums=(1,)))
+                self._pg_mixed_lora = _c(jax.jit(
+                    lora_wrap(self._paged_mixed_fn), donate_argnums=(1,),
+                    static_argnames=("n",)))
+                self._pg_decode_masked_lora = _c(jax.jit(
+                    lora_wrap(self._paged_decode_masked_fn),
+                    donate_argnums=(1,)))
+                self._pg_spec_masked_lora = _c(jax.jit(
+                    lora_wrap(self._paged_spec_masked_fn),
+                    donate_argnums=(1,), static_argnames=("m",)))
+                self._pg_mixed_masked_lora = _c(jax.jit(
+                    lora_wrap(self._paged_mixed_masked_fn),
+                    donate_argnums=(1,), static_argnames=("n",)))
 
     # --- jitted pieces -------------------------------------------------------
 
@@ -1565,16 +1663,20 @@ class InferenceEngine:
             req.resume_last = hist[-1]
             req.resume_budget = int(self.slot_budget[slot])
             req.prompt_ids = list(hist[:-1])
-            self._paged_register_pages(hist[:-1], slot)
+            self._paged_register_pages(hist[:-1], slot, req.adapter)
         elif st is not None and st["done"] > 0:
             # mid-prefill: nothing emitted — requeue as a fresh prompt,
             # but keep the already-computed full pages reusable
-            self._paged_register_pages(req.prompt_ids[:st["done"]], slot)
+            self._paged_register_pages(req.prompt_ids[:st["done"]], slot,
+                                       req.adapter)
         self.paged.release_slot(slot)
         self.slot_req[slot] = None
         self.slot_ready[slot] = False
         self.slot_budget[slot] = 0
         self.slot_hist[slot] = None
+        # the adapter pin rides the requeue (req.adapter_ref stays
+        # held); only the SLOT's stamp clears
+        self.slot_adapter[slot] = None
         # the grammar cursor itself stays on req.constraint_state —
         # re-admission resumes from the exact grammar position
         self.slot_constraint[slot] = None
@@ -1621,14 +1723,15 @@ class InferenceEngine:
                 and self.slot_ready[s]]
 
     def _paged_decode_dispatch(self, active: list[int], n: int, sub,
-                               gmask=None):
+                               gmask=None, lora=None):
         """Issue one paged decode dispatch (single-token via the
         ``_decode_fn`` body at n==1 so the rng use matches the
         contiguous program exactly; an n-step scan block otherwise).
         Pages for the writes were reserved by the caller. ``gmask``
         (constrained decoding) routes to the masked twin — the planner
-        guarantees n == 1 then. Returns the sampled tokens, shape
-        (max_slots, n)."""
+        guarantees n == 1 then. ``lora`` (multi-LoRA) routes to the
+        adapter twin of whichever program would run; both compose.
+        Returns the sampled tokens, shape (max_slots, n)."""
         W = self._paged_width(
             max(int(self.slot_len[s]) for s in active) + n)
         idxv = self._paged_index_vec(W, n)
@@ -1644,27 +1747,34 @@ class InferenceEngine:
                 jnp.asarray(self._top_k),
                 jnp.asarray(self._top_p),
                 jnp.asarray(self._greedy))
+        kw = {} if lora is None else {"lora": lora}
         if gmask is not None:
             if n != 1:
                 raise AssertionError(
                     f"grammar-masked paged decode must be n=1, got {n}")
-            tok, self.paged.kv = self._pg_decode_masked(
+            fn = (self._pg_decode_masked if lora is None
+                  else self._pg_decode_masked_lora)
+            tok, self.paged.kv = fn(
                 self.params, self.paged.kv, gidx, idxv, sidx, tokens,
-                sub, *args, jnp.asarray(gmask))
+                sub, *args, jnp.asarray(gmask), **kw)
             return tok[:, None]
         if n == 1:
-            tok, self.paged.kv = self._pg_decode(
+            fn = self._pg_decode if lora is None else self._pg_decode_lora
+            tok, self.paged.kv = fn(
                 self.params, self.paged.kv, gidx, idxv, sidx, tokens,
-                sub, *args)
+                sub, *args, **kw)
             return tok[:, None]
-        toks, self.paged.kv = self._pg_multi(
+        fn = self._pg_multi if lora is None else self._pg_multi_lora
+        toks, self.paged.kv = fn(
             self.params, self.paged.kv, gidx, idxv, sidx, tokens, sub,
-            *args, n=n)
+            *args, n=n, **kw)
         return toks
 
-    def _paged_register_pages(self, token_ids, slot: int) -> None:
+    def _paged_register_pages(self, token_ids, slot: int,
+                              adapter: str | None = None) -> None:
         """Index every FULL page of ``token_ids`` (whose KV fills
-        ``slot``'s first pages) for refcounted sharing."""
+        ``slot``'s first pages) for refcounted sharing. ``adapter``
+        namespaces the chain keys (multi-LoRA prefix isolation)."""
         if self.prefix_cache is None:
             return
         nfull = len(token_ids) // self.paged.page_size
@@ -1673,7 +1783,9 @@ class InferenceEngine:
         pages = self.paged.slot_pages(slot)[:nfull]
         if len(pages) == nfull:
             self.prefix_cache.register(
-                list(token_ids[:nfull * self.paged.page_size]), pages)
+                self._ns_ids(adapter,
+                             token_ids[:nfull * self.paged.page_size]),
+                pages)
 
     def _paged_gather_entry(self, slot: int, plen: int, last_logits):
         """Page-aligned prefix entry for ``slot``'s first ``plen``
@@ -1731,21 +1843,24 @@ class InferenceEngine:
 
     def submit(self, prompt_ids, params: SamplingParams | None = None, *,
                kv_entry=None, handoff_id: str | None = None,
-               trace=None) -> Request:
+               trace=None, adapter: str | None = None) -> Request:
         """``kv_entry`` (optional): a :class:`~.kv_pool.HostEntry` claimed
         from a handoff store — validated and uploaded HERE, on the
         caller's (HTTP) thread, so the engine loop admits it as a pure
         direct insert. ``handoff_id`` (optional): prefill-only request —
         publish the prompt KV under this id instead of decoding.
         ``trace`` (optional): a :class:`~..obs.trace.TraceContext` the
-        engine parents this request's phase spans to."""
+        engine parents this request's phase spans to.
+        ``adapter`` (optional): registered LoRA adapter name to decode
+        under (serve/multi_lora.py); unknown names raise ValueError on
+        this thread, before anything is queued."""
         params = params or SamplingParams()
         prompt_ids = list(map(int, prompt_ids))
         max_prompt = self.cache_len - 2
         if len(prompt_ids) > max_prompt:  # sliding-window crop (reference
             prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
         req = Request(next(self._uid), prompt_ids, params, engine=self,
-                      handoff_id=handoff_id, trace=trace)
+                      handoff_id=handoff_id, trace=trace, adapter=adapter)
         if (self.paged is not None
                 and not self.paged.fits_ever(len(prompt_ids) + 1)):
             # the prompt can NEVER fit the page pool (prompt pages + the
@@ -1761,6 +1876,20 @@ class InferenceEngine:
             self._record_finished(req)
             req.tokens.put(_FINISH)
             return req
+        if adapter is not None:
+            # pin the adapter for this request's whole lifetime — a
+            # refcounted row can't be evicted (or hot-swapped) while a
+            # request decodes under it; _record_finished releases
+            if self.adapter_registry is None:
+                raise ValueError(
+                    f"adapter {adapter!r} requested but the engine has "
+                    "no adapter_registry")
+            try:
+                self.adapter_registry.acquire(adapter)
+            except KeyError:
+                raise ValueError(
+                    f"unknown adapter {adapter!r}") from None
+            req.adapter_ref = True
         # the upload must land on the request BEFORE it is queued — the
         # engine thread may admit it the instant the put releases
         if kv_entry is not None:
@@ -1793,6 +1922,52 @@ class InferenceEngine:
             if n <= b:
                 return b
         return self.cache_len
+
+    # --- multi-LoRA plumbing (serve/multi_lora.py, ISSUE 15) -----------------
+
+    def _ns_ids(self, adapter: str | None, token_ids) -> list[int]:
+        """Prefix-cache key namespace: tokens shifted by the adapter's
+        registry generation (``t + (ns << 32)``) — length-preserving and
+        injective (Python ints don't narrow), so BOTH cache layouts'
+        token-tuple keys (PrefixLRU windows, kv-pool tiers, per-page
+        paged chains) isolate tenants without any cache-side change.
+        LoRA targets include v_proj by default, so adapter KV differs
+        from base KV row-for-row — cross-tenant hits would be silent
+        corruption, and a hot-swapped adapter name must miss its own
+        stale entries (fresh ns per register covers that). Base requests
+        (ns 0) keep the identity mapping: existing keys, entries and
+        cross-restart pool contents stay valid."""
+        ns = (self.adapter_registry.ns_of(adapter)
+              if self.adapter_registry is not None and adapter is not None
+              else 0)
+        if ns == 0:
+            return token_ids if isinstance(token_ids, list) \
+                else list(token_ids)
+        shift = ns << 32
+        return [int(t) + shift for t in token_ids]
+
+    def _lora_args(self):
+        """Gathered-BGMV jit args for a SLOT-WIDE dispatch (decode /
+        mixed / spec / chunk_batch rows are the max_slots slot plane),
+        or None when every slot is base — the caller then runs the base
+        executable and the twin never traces. Computed OUTSIDE the
+        dispatch_wait scope (the gmask idiom): the bank snapshot is
+        host work, booked as ``adapter_gather``."""
+        reg = self.adapter_registry
+        if reg is None or all(a is None for a in self.slot_adapter):
+            return None
+        with self.steptrace.scope("adapter_gather"):
+            return reg.dispatch_args(list(self.slot_adapter))
+
+    def _lora_args_for(self, adapters: list[str | None]):
+        """Gathered-BGMV jit args for a dispatch whose batch rows are
+        REQUESTS (grouped prefill) or a single slot, not the slot
+        plane."""
+        reg = self.adapter_registry
+        if reg is None or all(a is None for a in adapters):
+            return None
+        with self.steptrace.scope("adapter_gather"):
+            return reg.dispatch_args(list(adapters))
 
     def _trace_phase(self, req: Request, name: str, duration_s: float,
                      **attrs) -> None:
@@ -1859,6 +2034,17 @@ class InferenceEngine:
         # nulls it, never ran) — 128 retained multi-MB buffers under
         # sustained overload is an OOM, not a debug view
         req.kv_entry = None
+        # multi-LoRA: drop the submit-time adapter pin and book the
+        # tenant's generated tokens (llm_tenant_tokens_total{adapter=…}).
+        # This is the SINGLE finish funnel — sheds, handoff publishes
+        # and normal finishes all pass here exactly once; preempt
+        # requeues do NOT, so the ref rides the requeue.
+        if req.adapter_ref:
+            req.adapter_ref = False
+            reg = self.adapter_registry
+            if reg is not None:
+                reg.release(req.adapter)
+                reg.note_tokens(req.adapter, req.n_generated)
         self.finished.append(req)
 
     def _note_device_phase(self, phase: str, *, tokens: int,
@@ -1990,10 +2176,12 @@ class InferenceEngine:
                         "llm_local_prefills_total")
             if hit is None and not self._should_chunk(0, plen):
                 self.slot_req[slot] = req   # reserve; activated post-batch
+                self.slot_adapter[slot] = req.adapter
                 self.slot_ready[slot] = False
                 cacheable = (self.prefix_cache is not None
                              and plen >= self.prefix_cache.min_prefix)
-                if cacheable and tuple(req.prompt_ids) in seen:
+                if cacheable and (req.adapter,
+                                  tuple(req.prompt_ids)) in seen:
                     # duplicate of a prompt prefilling THIS burst: after
                     # the batch stores its prefix entry this becomes a
                     # full-prefix hit — keep the sequential path's
@@ -2002,7 +2190,7 @@ class InferenceEngine:
                     deferred.append((slot, req, plen))
                 else:
                     if cacheable:
-                        seen.add(tuple(req.prompt_ids))
+                        seen.add((req.adapter, tuple(req.prompt_ids)))
                     self._note_cache_outcome(req, None, plen)
                     batch.append((slot, req, plen))
             else:
@@ -2058,6 +2246,7 @@ class InferenceEngine:
                     kept.append((slot, req, plen))
                 else:
                     self.slot_req[slot] = None
+                    self.slot_adapter[slot] = None
                     self.slot_ready[slot] = False
                     self._paged_admit_blocked = True
                     blocked.append(req)
@@ -2087,10 +2276,17 @@ class InferenceEngine:
                     for j, (_, req, plen) in enumerate(part):
                         ids[j, :plen] = req.prompt_ids
                         lens[j] = plen
+                # per-REQUEST adapter rows (the one dispatch whose batch
+                # dim is requests, not the slot plane)
+                lora = self._lora_args_for(
+                    [r.adapter for _, r, _ in part])
+                kw = {} if lora is None else {"lora": lora}
+                pf = self._prefill if lora is None else self._prefill_lora
                 with self.steptrace.scope("dispatch_wait"):
                     t0 = time.monotonic()
-                    last, pre = self._prefill(
-                        self.params, jnp.asarray(ids), jnp.asarray(lens))
+                    last, pre = pf(
+                        self.params, jnp.asarray(ids), jnp.asarray(lens),
+                        **kw)
                     if self.paged is not None:
                         sidx = self.paged.rows_scatter_idx(
                             [p[0] for p in part], [p[2] for p in part],
@@ -2213,6 +2409,7 @@ class InferenceEngine:
             self.slot_ready[slot] = False
             self.slot_budget[slot] = 0
             self.slot_hist[slot] = None
+            self.slot_adapter[slot] = None
             if not self._publishers:
                 self._publishers = [
                     threading.Thread(target=self._run_publisher,
@@ -2406,7 +2603,11 @@ class InferenceEngine:
 
         if self.prefix_cache is None:
             return None
-        hit = self.prefix_cache.lookup(req.prompt_ids, usable)
+        # multi-LoRA: adapter-namespaced key tokens — tenants (whose
+        # adapters rewrite v_proj, hence the KV rows themselves) can
+        # never hit each other's entries, including the base model's
+        key_ids = self._ns_ids(req.adapter, req.prompt_ids)
+        hit = self.prefix_cache.lookup(key_ids, usable)
         if hit is not None or self.kv_pool is None:
             return hit
         # L1 miss: cascade into the host/remote pool; a hit is promoted
@@ -2414,10 +2615,10 @@ class InferenceEngine:
         # reads only entry metadata (length/bucket/slot_axis), so it
         # filters host entries before the device upload (and remote
         # entries before promotion).
-        hit = self.kv_pool.lookup(req.prompt_ids, usable=usable)
+        hit = self.kv_pool.lookup(key_ids, usable=usable)
         if hit is None:
             return None
-        self.prefix_cache.put(req.prompt_ids[: hit.length], hit)
+        self.prefix_cache.put(key_ids[: hit.length], hit)
         return hit
 
     def _paged_lookup(self, req: Request, plen: int):
@@ -2443,7 +2644,8 @@ class InferenceEngine:
                             last_logits=ext.last_logits, external=True)
         if self.prefix_cache is None:
             return None
-        pages = self.prefix_cache.lookup(req.prompt_ids)
+        key_ids = self._ns_ids(req.adapter, req.prompt_ids)
+        pages = self.prefix_cache.lookup(key_ids)
         if pages:
             return PagedHit(length=len(pages) * self.paged.page_size,
                             pages=pages)
@@ -2471,12 +2673,12 @@ class InferenceEngine:
         if isinstance(self.kv_pool, TieredKV):
             # host-side entries: the rows are page-scattered at
             # admission, so a whole-entry device upload would be waste
-            host = self.kv_pool.lookup(req.prompt_ids, usable=usable,
+            host = self.kv_pool.lookup(key_ids, usable=usable,
                                        device=False)
         else:
             # bare pools (HostKVPool etc.) have no device kwarg and
             # already return host entries
-            host = self.kv_pool.lookup(req.prompt_ids, usable=usable)
+            host = self.kv_pool.lookup(key_ids, usable=usable)
         if host is None:
             return None
         return PagedHit(
@@ -2515,6 +2717,7 @@ class InferenceEngine:
             # (decode-side growth may preempt; admission never does)
             self.paged.release_slot(slot)
             self.slot_req[slot] = None
+            self.slot_adapter[slot] = None
             if hit is not None and hit.entry is not None and hit.external:
                 # a handoff claim is consume-once: stash it back on the
                 # request (and un-count the consumption) or the retry
@@ -2531,7 +2734,8 @@ class InferenceEngine:
             # promote the tier hit into the page index: the next
             # request with this prefix shares pages instead of
             # re-fetching rows
-            self._paged_register_pages(req.prompt_ids[:hit.length], slot)
+            self._paged_register_pages(req.prompt_ids[:hit.length], slot,
+                                       req.adapter)
             if hit.length == plen:
                 self._activate(slot, req, plen, hit.last_logits)
                 return
@@ -2571,12 +2775,17 @@ class InferenceEngine:
             self._paged_cow_fork(slot, done, len(suffix))
             sidx = self.paged.scatter_idx(starts, valid, C)
             gidx = self.paged.gather_idx(W)
+        # slot-plane adapters: only ``slot``'s row is live, the rest are
+        # dead trash-page windows whose delta doesn't matter
+        lora = self._lora_args()
+        kw = {} if lora is None else {"lora": lora}
         with self.steptrace.scope("dispatch_wait"):
             t0 = time.monotonic()
-            last, self.paged.kv = self._pg_chunk(
+            fn = self._pg_chunk if lora is None else self._pg_chunk_lora
+            last, self.paged.kv = fn(
                 self.params, self.paged.kv, jnp.asarray(gidx),
                 jnp.asarray(tok), jnp.asarray(starts), jnp.asarray(lens),
-                jnp.asarray(sidx))
+                jnp.asarray(sidx), **kw)
             out = last[slot:slot + 1]
             # force + stamp dt exactly like _prefill_into_slot (the
             # logits feed the first-token sample on this same call path
@@ -2599,6 +2808,10 @@ class InferenceEngine:
         long remainder (chunked prefill on) → incremental, one chunk per
         engine step so running slots keep decoding; otherwise one-shot.
         ``hit`` may be passed by ``_admit`` (which already looked it up)."""
+        # stamp the slot's adapter BEFORE any prefill dispatch — the
+        # suffix/chunk programs below read the slot plane for their
+        # gathered-BGMV indices
+        self.slot_adapter[slot] = req.adapter
         if self.paged is not None:
             if hit is self._UNSET:
                 hit = self._lookup_prefix(req, plen)
@@ -2681,27 +2894,37 @@ class InferenceEngine:
             pf_tokens = sum(len(c) for _, _, c in entries)
             pf_keys = sum(CostModel.chunk_keys(len(c), st["done"])
                           for _, st, c in entries)
+            lora = self._lora_args()   # slot-plane (batched chunk rows)
+            kw = {} if lora is None else {"lora": lora}
             with self.steptrace.scope("dispatch_wait"):
                 t0 = time.monotonic()
                 if self.paged is not None:
-                    self._paged_chunk_dispatch(entries)
+                    self._paged_chunk_dispatch(entries, lora=lora)
                 elif batchable:
                     tok, starts, lens = self._chunk_batch_rows(entries)
-                    last, self.cache = self._chunk_batch(
+                    fn = (self._chunk_batch if lora is None
+                          else self._chunk_batch_lora)
+                    last, self.cache = fn(
                         self.params, self.cache, jnp.asarray(tok),
-                        jnp.asarray(starts), jnp.asarray(lens))
+                        jnp.asarray(starts), jnp.asarray(lens), **kw)
                     for slot, st, chunk in entries:
                         st["last_logits"] = last[slot:slot + 1]
                         st["done"] += len(chunk)
                 else:
                     for slot, st, chunk in entries:
+                        # the 1-row program wants a 1-row index array
+                        sl = self._lora_args_for([st["req"].adapter])
+                        skw = {} if sl is None else {"lora": sl}
+                        fn = (self._chunk_slot if sl is None
+                              else self._chunk_slot_lora)
                         padded = np.zeros((1, C), np.int32)
                         padded[0, :len(chunk)] = chunk
-                        st["last_logits"], self.cache = self._chunk_slot(
+                        st["last_logits"], self.cache = fn(
                             self.params, self.cache, jnp.asarray(padded),
                             jnp.asarray(slot, jnp.int32),
                             jnp.asarray(st["done"], jnp.int32),
                             jnp.asarray(len(chunk), jnp.int32),
+                            **skw,
                         )
                         st["done"] += len(chunk)
                 # force the chunks' last-logits before stamping dt: on
@@ -2762,7 +2985,7 @@ class InferenceEngine:
             lens[slot] = len(chunk)
         return tok, starts, lens
 
-    def _paged_chunk_dispatch(self, entries) -> None:
+    def _paged_chunk_dispatch(self, entries, lora=None) -> None:
         """Advance every mid-prefill row one chunk against the PAGE
         POOL in a single dispatch: gather a bucketed contiguous view,
         run the shared ``batched_chunk`` body, scatter each prefill
@@ -2783,10 +3006,12 @@ class InferenceEngine:
             self._paged_cow_fork(slot, st["done"], len(chunk))
         sidx = self.paged.scatter_idx(starts, valid, C)
         gidx = self.paged.gather_idx(W)
-        last, self.paged.kv = self._pg_chunk(
+        kw = {} if lora is None else {"lora": lora}
+        fn = self._pg_chunk if lora is None else self._pg_chunk_lora
+        last, self.paged.kv = fn(
             self.params, self.paged.kv, jnp.asarray(gidx),
             jnp.asarray(tok), jnp.asarray(starts), jnp.asarray(lens),
-            jnp.asarray(sidx))
+            jnp.asarray(sidx), **kw)
         for slot, st, chunk in entries:
             st["last_logits"] = last[slot:slot + 1]
             st["done"] += len(chunk)
@@ -2826,11 +3051,12 @@ class InferenceEngine:
         is duck-typed: a lookup-only pool (bare HostKVPool) simply gets
         no copies."""
         if self.prefix_cache is not None:
-            self._paged_register_pages(req.prompt_ids[:plen], slot)
+            self._paged_register_pages(req.prompt_ids[:plen], slot,
+                                       req.adapter)
         if (self.kv_pool is not None
                 and getattr(self.kv_pool, "offload_on_put", False)):
             self.kv_pool.offload(
-                req.prompt_ids[:plen],
+                self._ns_ids(req.adapter, req.prompt_ids[:plen]),
                 self._paged_gather_entry(slot, plen, last_logits))
 
     def _store_prefix(self, req: Request, plen: int, pre_cache,
@@ -2853,11 +3079,12 @@ class InferenceEngine:
             last_logits=last_logits,
             slot_axis=self._sax,
         )
-        self.prefix_cache.put(req.prompt_ids, entry)
+        key_ids = self._ns_ids(req.adapter, req.prompt_ids)
+        self.prefix_cache.put(key_ids, entry)
         if self.kv_pool is not None and self.kv_pool.offload_on_put:
             # LMCache streaming write-through: the pool copy means a
             # sibling / restarted engine starts with this prefix warm.
-            self.kv_pool.offload(req.prompt_ids[:plen], entry)
+            self.kv_pool.offload(key_ids[:plen], entry)
 
     def _finish_prefill(self, req: Request, slot: int, plen: int,
                         pre_cache, last_logits) -> None:
@@ -2875,24 +3102,29 @@ class InferenceEngine:
             return self._prefill_into_slot_timed(req, slot, plen, hit)
 
     def _prefill_into_slot_timed(self, req, slot, plen, hit):
+        lora = self._lora_args_for([req.adapter])
+        kw = {} if lora is None else {"lora": lora}
         t0 = time.monotonic()
         if hit is not None:
             suffix = req.prompt_ids[hit.length:]
             sbucket = self._bucket_for(len(suffix))
             padded = np.zeros((1, sbucket), np.int32)
             padded[0, :len(suffix)] = suffix
-            last_logits, pre_cache = self._prefill_suffix(
+            fn = (self._prefill_suffix if lora is None
+                  else self._prefill_suffix_lora)
+            last_logits, pre_cache = fn(
                 self.params, hit.rows, jnp.asarray(hit.length, jnp.int32),
                 jnp.asarray(padded), jnp.asarray(len(suffix), jnp.int32),
-            )
+                **kw)
             new, start = len(suffix), hit.length
         else:
             bucket = self._bucket_for(plen)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :plen] = req.prompt_ids
-            last_logits, pre_cache = self._prefill(
+            fn = self._prefill if lora is None else self._prefill_lora
+            last_logits, pre_cache = fn(
                 self.params, jnp.asarray(padded),
-                jnp.asarray([plen], jnp.int32)
+                jnp.asarray([plen], jnp.int32), **kw
             )
             new, start = plen, 0
         # force + stamp dt BEFORE the insert/prefix-store work so this
@@ -2933,7 +3165,7 @@ class InferenceEngine:
         if self.paged is not None:
             hist = self.slot_hist[slot]
             if hist:
-                self._paged_register_pages(hist[:-1], slot)
+                self._paged_register_pages(hist[:-1], slot, req.adapter)
             self.paged.release_slot(slot)
         # breakdown finalized BEFORE _FINISH is released: a consumer
         # that saw the stream end must find the request in the
@@ -2945,6 +3177,7 @@ class InferenceEngine:
         self.slot_ready[slot] = False
         self.slot_budget[slot] = 0
         self.slot_constraint[slot] = None
+        self.slot_adapter[slot] = None
 
     def _emit(self, slot: int, token_id: int):
         req = self.slot_req[slot]
@@ -3078,6 +3311,10 @@ class InferenceEngine:
         # (_plan_block capped the block at 1 for constrained actives,
         # so m == 0 here whenever gmasks is not None.)
         gmasks = self._grammar_spec_masks(active, tokens, k, drafts)
+        # multi-LoRA: the verify IS the target forward, so the adapter
+        # delta rides the spec twins; the drafts above stayed base-model
+        lora = self._lora_args()
+        kw = {} if lora is None else {"lora": lora}
         with self.steptrace.scope("dispatch_wait"):
             t0 = time.monotonic()
             if self.paged is not None:
@@ -3091,29 +3328,35 @@ class InferenceEngine:
                     self._paged_cow_fork(s, int(self.slot_len[s]),
                                          k + 1 + m)
                 if gmasks is not None:
-                    out, n_acc, extra, self.paged.kv = (
-                        self._pg_spec_masked(
-                            self.params, self.paged.kv,
-                            jnp.asarray(self.paged.gather_idx(W)),
-                            jnp.asarray(idxv),
-                            jnp.asarray(self.paged.scatter_idx(
-                                idxv, valid, k + 1 + m)),
-                            jnp.asarray(tokens), jnp.asarray(mask),
-                            jnp.asarray(gmasks), m=m))
+                    fn = (self._pg_spec_masked if lora is None
+                          else self._pg_spec_masked_lora)
+                    out, n_acc, extra, self.paged.kv = fn(
+                        self.params, self.paged.kv,
+                        jnp.asarray(self.paged.gather_idx(W)),
+                        jnp.asarray(idxv),
+                        jnp.asarray(self.paged.scatter_idx(
+                            idxv, valid, k + 1 + m)),
+                        jnp.asarray(tokens), jnp.asarray(mask),
+                        jnp.asarray(gmasks), m=m, **kw)
                 else:
-                    out, n_acc, extra, self.paged.kv = self._pg_spec(
+                    fn = (self._pg_spec if lora is None
+                          else self._pg_spec_lora)
+                    out, n_acc, extra, self.paged.kv = fn(
                         self.params, self.paged.kv,
                         jnp.asarray(self.paged.gather_idx(W)),
                         jnp.asarray(idxv),
                         jnp.asarray(self.paged.scatter_idx(idxv, valid,
                                                            k + 1 + m)),
-                        jnp.asarray(tokens), jnp.asarray(mask), m=m)
+                        jnp.asarray(tokens), jnp.asarray(mask), m=m,
+                        **kw)
             elif gmasks is not None:
+                fn = (self._decode_spec_masked if lora is None
+                      else self._decode_spec_masked_lora)
                 base = self._paged_index_vec(self.cache_len, k + 1 + m)
-                out, n_acc, extra, self.cache = self._decode_spec_masked(
+                out, n_acc, extra, self.cache = fn(
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(base), jnp.asarray(mask),
-                    jnp.asarray(gmasks), m=m)
+                    jnp.asarray(gmasks), m=m, **kw)
             else:
                 # per-row pinned index: the slot-state → index
                 # convention lives in ONE place (_paged_index_vec reads
@@ -3124,9 +3367,11 @@ class InferenceEngine:
                 # (_spec_applicable + the headroom cap on m), so their
                 # clamp is a no-op.
                 base = self._paged_index_vec(self.cache_len, k + 1 + m)
-                out, n_acc, extra, self.cache = self._decode_spec(
+                fn = (self._decode_spec if lora is None
+                      else self._decode_spec_lora)
+                out, n_acc, extra, self.cache = fn(
                     self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(base), jnp.asarray(mask), m=m)
+                    jnp.asarray(base), jnp.asarray(mask), m=m, **kw)
             out_host = np.asarray(out)
             acc_host = np.asarray(n_acc)
             extra_host = np.asarray(extra)
@@ -3409,6 +3654,11 @@ class InferenceEngine:
         # mid-prefill rows need nothing — their first token samples at
         # finalization, where _activate applies the start-state mask
         gmask = self._grammar_masks(active)
+        # multi-LoRA: slot-plane adapter rows cover BOTH halves of the
+        # fused program (prefill rows and decode rows are the same
+        # max_slots plane)
+        lora = self._lora_args()
+        kw = {} if lora is None else {"lora": lora}
         # per-phase device accounting for the ONE fused dispatch: the
         # wall time is split between prefill and decode in proportion
         # to each half's FLOPs (token-count fallback without a cost
@@ -3450,24 +3700,27 @@ class InferenceEngine:
                     valid[s] = n
                     self._paged_cow_fork(s, int(self.slot_len[s]), n)
                 if gmask is not None:
-                    chunk_last, toks, self.paged.kv = (
-                        self._pg_mixed_masked(
-                            self.params, self.paged.kv,
-                            jnp.asarray(self.paged.gather_idx(W)),
-                            jnp.asarray(tok), jnp.asarray(starts),
-                            jnp.asarray(lens), jnp.asarray(advance),
-                            jnp.asarray(self.slot_last_token), sub,
-                            jnp.asarray(self._temperature),
-                            jnp.asarray(self._top_k),
-                            jnp.asarray(self._top_p),
-                            jnp.asarray(self._greedy),
-                            jnp.asarray(gmask),
-                            jnp.asarray(self.paged.scatter_idx(
-                                starts, valid, C)),
-                            n=n,
-                        ))
+                    fn = (self._pg_mixed_masked if lora is None
+                          else self._pg_mixed_masked_lora)
+                    chunk_last, toks, self.paged.kv = fn(
+                        self.params, self.paged.kv,
+                        jnp.asarray(self.paged.gather_idx(W)),
+                        jnp.asarray(tok), jnp.asarray(starts),
+                        jnp.asarray(lens), jnp.asarray(advance),
+                        jnp.asarray(self.slot_last_token), sub,
+                        jnp.asarray(self._temperature),
+                        jnp.asarray(self._top_k),
+                        jnp.asarray(self._top_p),
+                        jnp.asarray(self._greedy),
+                        jnp.asarray(gmask),
+                        jnp.asarray(self.paged.scatter_idx(
+                            starts, valid, C)),
+                        n=n, **kw,
+                    )
                 else:
-                    chunk_last, toks, self.paged.kv = self._pg_mixed(
+                    fn = (self._pg_mixed if lora is None
+                          else self._pg_mixed_lora)
+                    chunk_last, toks, self.paged.kv = fn(
                         self.params, self.paged.kv,
                         jnp.asarray(self.paged.gather_idx(W)),
                         jnp.asarray(tok), jnp.asarray(starts),
@@ -3479,10 +3732,12 @@ class InferenceEngine:
                         jnp.asarray(self._greedy),
                         jnp.asarray(self.paged.scatter_idx(
                             starts, valid, C)),
-                        n=n,
+                        n=n, **kw,
                     )
             elif gmask is not None:
-                chunk_last, toks, self.cache = self._mixed_masked(
+                fn = (self._mixed_masked if lora is None
+                      else self._mixed_masked_lora)
+                chunk_last, toks, self.cache = fn(
                     self.params, self.cache, jnp.asarray(tok),
                     jnp.asarray(starts), jnp.asarray(lens),
                     jnp.asarray(advance),
@@ -3492,10 +3747,11 @@ class InferenceEngine:
                     jnp.asarray(self._top_p),
                     jnp.asarray(self._greedy),
                     jnp.asarray(gmask),
-                    n=n,
+                    n=n, **kw,
                 )
             else:
-                chunk_last, toks, self.cache = self._mixed(
+                fn = self._mixed if lora is None else self._mixed_lora
+                chunk_last, toks, self.cache = fn(
                     self.params, self.cache, jnp.asarray(tok),
                     jnp.asarray(starts), jnp.asarray(lens),
                     jnp.asarray(advance),
@@ -3504,7 +3760,7 @@ class InferenceEngine:
                     jnp.asarray(self._top_k),
                     jnp.asarray(self._top_p),
                     jnp.asarray(self._greedy),
-                    n=n,
+                    n=n, **kw,
                 )
             toks_host = np.asarray(toks)  # forces the dispatch's results
             dt = time.monotonic() - t0
@@ -3696,12 +3952,17 @@ class InferenceEngine:
                     active = self._paged_reserve_active(active, n)
                 if not active:
                     return True  # reservation finished/preempted them all
+            lora = self._lora_args()
+            kw = {} if lora is None else {"lora": lora}
             with self.steptrace.scope("dispatch_wait"):
                 t0 = time.monotonic()
                 if self.paged is not None:
-                    toks = self._paged_decode_dispatch(active, n, sub)
+                    toks = self._paged_decode_dispatch(active, n, sub,
+                                                       lora=lora)
                 else:
-                    toks, self.cache = self._decode_multi(
+                    fn = (self._decode_multi if lora is None
+                          else self._decode_multi_lora)
+                    toks, self.cache = fn(
                         self.params, self.cache,
                         jnp.asarray(self.slot_last_token),
                         sub,
@@ -3709,7 +3970,7 @@ class InferenceEngine:
                         jnp.asarray(self._top_k),
                         jnp.asarray(self._top_p),
                         jnp.asarray(self._greedy),
-                        n=n,
+                        n=n, **kw,
                     )
                 toks_host = np.asarray(toks)
                 keys = sum(CostModel.block_keys(n, int(self.slot_len[s]))
@@ -3732,14 +3993,19 @@ class InferenceEngine:
         # constrained decoding: per-slot grammar mask rows, applied by
         # the masked twin program in the SAME single dispatch
         gmask = self._grammar_masks(active)
+        lora = self._lora_args()
+        kw = {} if lora is None else {"lora": lora}
         with self.steptrace.scope("dispatch_wait"):
             t0 = time.monotonic()
             if self.paged is not None:
                 next_tok = self._paged_decode_dispatch(active, 1, sub,
-                                                       gmask=gmask)
+                                                       gmask=gmask,
+                                                       lora=lora)
                 next_tok = next_tok[:, 0]
             elif gmask is not None:
-                next_tok, self.cache = self._decode_masked(
+                fn = (self._decode_masked if lora is None
+                      else self._decode_masked_lora)
+                next_tok, self.cache = fn(
                     self.params, self.cache,
                     jnp.asarray(self.slot_last_token),
                     sub,
@@ -3747,10 +4013,11 @@ class InferenceEngine:
                     jnp.asarray(self._top_k),
                     jnp.asarray(self._top_p),
                     jnp.asarray(self._greedy),
-                    jnp.asarray(gmask),
+                    jnp.asarray(gmask), **kw,
                 )
             else:
-                next_tok, self.cache = self._decode(
+                fn = self._decode if lora is None else self._decode_lora
+                next_tok, self.cache = fn(
                     self.params, self.cache,
                     jnp.asarray(self.slot_last_token),
                     sub,
@@ -3758,6 +4025,7 @@ class InferenceEngine:
                     jnp.asarray(self._top_k),
                     jnp.asarray(self._top_p),
                     jnp.asarray(self._greedy),
+                    **kw,
                 )
             next_host = np.asarray(next_tok)
             keys = sum(CostModel.block_keys(1, int(self.slot_len[s]))
@@ -3895,9 +4163,10 @@ class InferenceEngine:
 
     # --- convenience ---------------------------------------------------------
 
-    def generate(self, prompt_ids, params: SamplingParams | None = None) -> list[int]:
+    def generate(self, prompt_ids, params: SamplingParams | None = None,
+                 *, adapter: str | None = None) -> list[int]:
         """Blocking single-request helper (drives steps if no thread runs)."""
-        req = self.submit(prompt_ids, params)
+        req = self.submit(prompt_ids, params, adapter=adapter)
         if self._thread is None:
             while self.step():
                 pass

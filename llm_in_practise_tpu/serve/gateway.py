@@ -492,6 +492,9 @@ class Gateway:
         tracer=None,
         ttft_slo_s: float | None = None,
         tpot_slo_s: float | None = None,
+        tenant_quotas: dict[str, float] | None = None,
+        tenant_weights: dict[str, float] | None = None,
+        tenant_quota_window_s: float = 60.0,
     ):
         self.router = router
         self.retry_policy = retry_policy
@@ -514,6 +517,26 @@ class Gateway:
         # prefill phases that published KV / errored (degraded)
         self.handoff_total = 0         # guarded-by: _stats_lock
         self.handoff_failed_total = 0  # guarded-by: _stats_lock
+        # per-tenant fairness (multi-LoRA serving, ISSUE 15): one token
+        # bucket per tenant (= the request's model/adapter name).
+        # ``tenant_quotas[t]`` is t's output-token budget per
+        # ``tenant_quota_window_s``; ``tenant_weights[t]`` scales the
+        # burst capacity (weighted admission — a weight-2 tenant may
+        # burst twice its refill window). Admission only requires a
+        # POSITIVE balance; the ACTUAL completion tokens are debited
+        # after the response (the gateway cannot know them up front),
+        # so one oversized reply overdraws the bucket and the tenant
+        # 429s until the refill pays the debt back.
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_quota_window_s = float(tenant_quota_window_s)
+        self._tenant_lock = threading.Lock()
+        self._tenant_balance: dict[str, float] = {}   # guarded-by: _tenant_lock
+        self._tenant_refill_t: dict[str, float] = {}  # guarded-by: _tenant_lock
+        self.tenant_tokens: dict[str, int] = {}       # guarded-by: _tenant_lock
+        self.tenant_rejections: dict[str, int] = {}   # guarded-by: _tenant_lock
+        # tenant -> {"ok": n, "violated": n} output tokens by SLO verdict
+        self.tenant_goodput: dict[str, dict] = {}     # guarded-by: _tenant_lock
         self._disagg_model_warned: set = set()
         self._httpd: ThreadingHTTPServer | None = None
         self._health_thread: threading.Thread | None = None
@@ -738,22 +761,32 @@ class Gateway:
         try:
             status, resp = self._route(body, stream, span)
             span.set(status=status)
-            if status == 200 and self.goodput.enabled:
+            if status == 200:
                 trace_id = getattr(span.context(), "trace_id", None)
+                tenant = str(body.get("model") or "")
                 if isinstance(resp, dict):
-                    # non-stream: only end-to-end latency is observable
-                    # here — the goodput meter applies the request-level
-                    # deadline ttft_slo + (n-1)·tpot_slo
-                    tokens = int((resp.get("usage") or {})
-                                 .get("completion_tokens") or 0)
-                    self.goodput.observe(tokens=tokens,
-                                         total_s=time.monotonic() - t0,
-                                         trace_id=trace_id)
+                    if not resp.get("cached"):
+                        # non-stream: only end-to-end latency is
+                        # observable here — the goodput meter applies
+                        # the request-level deadline
+                        # ttft_slo + (n-1)·tpot_slo
+                        tokens = int((resp.get("usage") or {})
+                                     .get("completion_tokens") or 0)
+                        violated = None
+                        if self.goodput.enabled:
+                            violated = self.goodput.observe(
+                                tokens=tokens,
+                                total_s=time.monotonic() - t0,
+                                trace_id=trace_id)
+                        self._tenant_debit(tenant, tokens, violated)
                 else:
                     # streaming: the SSE relay measures TTFT/TPOT on
-                    # the wire and books the request at stream close
-                    resp._goodput_t0 = t0
-                    resp._goodput_trace_id = trace_id
+                    # the wire and books the request (goodput + tenant
+                    # debit) at stream close
+                    if self.goodput.enabled:
+                        resp._goodput_t0 = t0
+                        resp._goodput_trace_id = trace_id
+                    resp._tenant = tenant
             return status, resp
         finally:
             # streaming success: the span closes at headers-received —
@@ -788,6 +821,15 @@ class Gateway:
                 resp = dict(cached)
                 resp["cached"] = True
                 return 200, resp
+
+        # per-tenant quota admission — after the cache (a cached reply
+        # costs no upstream tokens, so it is never charged or refused)
+        if not self._tenant_admit(group):
+            return 429, {"error": {
+                "message": f"tenant {group!r} token quota exhausted "
+                           "(retry after the bucket refills)",
+                "type": "tenant_quota_exhausted",
+            }}
 
         # context-window fallback: if the estimate exceeds the group's
         # window, skip straight to the larger-context chain
@@ -865,6 +907,66 @@ class Gateway:
                 "fallbacks": self.fallbacks_total,
                 "handoff": self.handoff_total,
                 "handoff_failed": self.handoff_failed_total,
+            }
+
+    # --- tenant fairness -----------------------------------------------------
+
+    def _tenant_capacity(self, tenant: str) -> float:
+        return (self.tenant_quotas[tenant]
+                * self.tenant_weights.get(tenant, 1.0))
+
+    def _tenant_admit(self, tenant: str) -> bool:
+        """Refill tenant's bucket and admit while the balance is
+        positive (quota-less tenants always pass). The refill rate is
+        capacity / window, so a weight-2 tenant both bursts deeper AND
+        recovers faster — proportional share, not just burst."""
+        quota = self.tenant_quotas.get(tenant)
+        if quota is None:
+            return True
+        cap = self._tenant_capacity(tenant)
+        with self._tenant_lock:
+            now = time.monotonic()
+            bal = self._tenant_balance.get(tenant, cap)
+            t_last = self._tenant_refill_t.get(tenant, now)
+            bal = min(cap, bal + (now - t_last) * cap
+                      / self.tenant_quota_window_s)
+            self._tenant_refill_t[tenant] = now
+            self._tenant_balance[tenant] = bal
+            if bal <= 0.0:
+                self.tenant_rejections[tenant] = (
+                    self.tenant_rejections.get(tenant, 0) + 1)
+                return False
+            return True
+
+    def _tenant_debit(self, tenant: str, tokens: int,
+                      violated: bool | None = None) -> None:
+        """Book delivered output tokens against tenant's bucket and
+        per-tenant counters. ``violated``: the goodput verdict for the
+        request these tokens came from (None = accounting off)."""
+        if not tenant:
+            return
+        with self._tenant_lock:
+            self.tenant_tokens[tenant] = (
+                self.tenant_tokens.get(tenant, 0) + tokens)
+            if violated is not None:
+                d = self.tenant_goodput.setdefault(
+                    tenant, {"ok": 0, "violated": 0})
+                d["violated" if violated else "ok"] += tokens
+            if tenant in self.tenant_quotas:
+                bal = self._tenant_balance.get(
+                    tenant, self._tenant_capacity(tenant))
+                self._tenant_balance[tenant] = bal - tokens
+
+    def _tenant_snapshot(self) -> dict:
+        """Per-tenant counters read under their lock — the one helper
+        the scrape callbacks go through (mirrors _counter_snapshot)."""
+        with self._tenant_lock:
+            return {
+                "tokens": dict(self.tenant_tokens),
+                "rejections": dict(self.tenant_rejections),
+                "goodput": {t: dict(d)
+                            for t, d in self.tenant_goodput.items()},
+                "balance": dict(self._tenant_balance),
             }
 
     # --- health checks -------------------------------------------------------
@@ -959,6 +1061,37 @@ class Gateway:
                          per_upstream(lambda u: u.cooldowns))
         reg.counter_func("gateway_upstream_affinity_hits_total",
                          per_upstream(lambda u: u.affinity_hits))
+
+        # per-tenant fairness plane (multi-LoRA serving, ISSUE 15):
+        # registered unconditionally — tenants appear as they first
+        # route; without quotas the rejection/balance families render
+        # no samples. All reads go through _tenant_snapshot (one lock
+        # acquisition per family collect).
+        def per_tenant(key):
+            def collect():
+                return [({"tenant": t}, v) for t, v in
+                        sorted(self._tenant_snapshot()[key].items())]
+            return collect
+
+        reg.counter_func("gateway_tenant_tokens_total",
+                         per_tenant("tokens"),
+                         "completion tokens delivered per tenant "
+                         "(streaming: wire-delta lower bound)")
+        reg.counter_func("gateway_tenant_quota_rejections_total",
+                         per_tenant("rejections"),
+                         "requests 429'd at the tenant token bucket")
+        reg.counter_func(
+            "gateway_tenant_goodput_tokens_total",
+            lambda: [({"tenant": t, "slo": slo}, d[slo])
+                     for t, d in sorted(
+                         self._tenant_snapshot()["goodput"].items())
+                     for slo in ("ok", "violated")],
+            "per-tenant output tokens by the SLO outcome of their "
+            "request (empty until goodput thresholds are configured)")
+        reg.gauge_func("gateway_tenant_quota_balance",
+                       per_tenant("balance"),
+                       "current token-bucket balance per quota'd "
+                       "tenant (negative = overdrawn, refilling)")
         return reg
 
     def metrics_text(self) -> str:
@@ -1029,6 +1162,8 @@ class Gateway:
                 self.send_header("Connection", "close")
                 self.end_headers()
                 t0 = getattr(upstream_resp, "_goodput_t0", None)
+                tenant = getattr(upstream_resp, "_tenant", None)
+                count = t0 is not None or bool(tenant)
                 first = last = None
                 n_deltas = 0
                 marker = b'"content"'
@@ -1041,7 +1176,7 @@ class Gateway:
                         chunk = upstream_resp.read(4096)
                         if not chunk:
                             break
-                        if t0 is not None:
+                        if count:
                             hay = tail + chunk
                             hits = hay.count(marker)
                             tail = hay[-(len(marker) - 1):]
@@ -1057,14 +1192,20 @@ class Gateway:
                     pass
                 finally:
                     upstream_resp.close()
+                    violated = None
                     if t0 is not None and first is not None:
                         tpot = ((last - first) / (n_deltas - 1)
                                 if n_deltas > 1 else None)
-                        gw.goodput.observe(
+                        violated = gw.goodput.observe(
                             tokens=n_deltas, ttft_s=first - t0,
                             tpot_s=tpot,
                             trace_id=getattr(upstream_resp,
                                              "_goodput_trace_id", None))
+                    if tenant:
+                        # wire-delta count is a lower bound on tokens
+                        # (the server may merge tokens per SSE event) —
+                        # conservative in the tenant's favor
+                        gw._tenant_debit(tenant, n_deltas, violated)
 
         return Handler
 
