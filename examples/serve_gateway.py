@@ -22,6 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from llm_in_practise_tpu.serve.gateway import (
     DisaggRouter,
     Gateway,
+    HashRingRouter,
     PrefixAffinityRouter,
     ResponseCache,
     RetryPolicy,
@@ -57,13 +58,30 @@ def main():
     p.add_argument("--moderation", action="store_true",
                    help="enable the pre-call guard hook")
     p.add_argument("--routing", default="least_pending",
-                   choices=["least_pending", "prefix_aware", "disagg"],
+                   choices=["least_pending", "prefix_aware", "ring",
+                            "disagg"],
                    help="prefix_aware pins conversations to one upstream "
-                        "(llm-d load_aware_prefix parity); disagg splits "
-                        "requests across #prefill and #decode role pools "
-                        "with KV handoff through the shared kv_pool "
-                        "server (llm-d disaggregation parity — replicas "
-                        "need --role + --kv-remote)")
+                        "(llm-d load_aware_prefix parity); ring routes "
+                        "by consistent hash on (session id | prefix | "
+                        "tenant) with bounded-load two-choice — the "
+                        "session-native default (serve/sessions.py; "
+                        "pair replicas with --session-store); disagg "
+                        "splits requests across #prefill and #decode "
+                        "role pools with KV handoff through the shared "
+                        "kv_pool server (llm-d disaggregation parity — "
+                        "replicas need --role + --kv-remote)")
+    p.add_argument("--ring-bound", dest="ring_bound", type=float,
+                   default=1.25, metavar="FACTOR",
+                   help="bounded-load factor for --routing ring: a ring "
+                        "owner whose pending load exceeds FACTOR x the "
+                        "group mean overflows to the key's second owner "
+                        "(then least-pending)")
+    p.add_argument("--session-ttl", dest="session_ttl", type=float,
+                   default=600.0, metavar="SECONDS",
+                   help="affinity/sticky-table TTL for prefix_aware "
+                        "routing; advisory for ring (the ring is "
+                        "memoryless — replicas enforce their own "
+                        "--session-ttl on pinned KV)")
     p.add_argument("--standby", action="append", default=[],
                    metavar="GROUP=URL[|MODEL]",
                    help="repeatable: replicas the autoscaler may bring into "
@@ -166,12 +184,17 @@ def main():
         if t not in tenant_quotas:
             p.error(f"--tenant-weight {t!r} has no matching --tenant-quota")
 
-    router_cls = {
-        "prefix_aware": PrefixAffinityRouter,
-        "disagg": DisaggRouter,
-    }.get(args.routing, Router)
+    if args.routing == "ring":
+        router = HashRingRouter(upstreams, bound=args.ring_bound)
+    elif args.routing == "prefix_aware":
+        router = PrefixAffinityRouter(
+            upstreams, affinity_ttl_s=args.session_ttl)
+    elif args.routing == "disagg":
+        router = DisaggRouter(upstreams)
+    else:
+        router = Router(upstreams)
     gw = Gateway(
-        router_cls(upstreams),
+        router,
         retry_policy=RetryPolicy(),
         cache=cache,
         fallbacks=fallbacks,

@@ -145,6 +145,19 @@ def main():
                    action="store_true",
                    help="reuse prompt-prefix KV across requests "
                         "(vLLM APC parity)")
+    p.add_argument("--session-store", dest="session_store",
+                   action="store_true",
+                   help="session-native serving (serve/sessions.py): "
+                        "requests carrying a session id (X-Session-ID "
+                        "header or body field) keep their conversation "
+                        "KV pinned across turns, and finished turns "
+                        "publish to the kv-pool handoff namespace when "
+                        "--kv-remote is set — the fleet-wide warm path "
+                        "behind the gateway's --routing ring")
+    p.add_argument("--session-ttl", dest="session_ttl", type=float,
+                   default=600.0, metavar="SECONDS",
+                   help="idle TTL for pinned session KV "
+                        "(with --session-store)")
     p.add_argument("--enable-chunked-prefill", dest="chunked_prefill",
                    type=int, nargs="?", const=256, default=None,
                    metavar="CHUNK",
@@ -442,10 +455,19 @@ def main():
             from llm_in_practise_tpu.serve.multi_lora import AdapterRegistry
 
             adapter_registry = AdapterRegistry(params, mesh=mesh)
+    session_store = None
+    if args.session_store:
+        from llm_in_practise_tpu.serve.sessions import SessionStore
+
+        session_store = SessionStore(ttl_s=args.session_ttl)
+        warm = ("fleet warm path via " + args.kv_remote
+                if args.kv_remote else "local pins only (no --kv-remote)")
+        print(f"session store: ttl {args.session_ttl:g}s, {warm}")
     engine = InferenceEngine(model, params,
                              kv_pool=make_kv_pool(args.model_name),
                              role=args.role, handoff=handoff,
                              adapter_registry=adapter_registry,
+                             session_store=session_store,
                              **engine_kw)
     adapters = {}
     if lora_modules and adapter_registry is not None:

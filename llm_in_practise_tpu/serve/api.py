@@ -337,11 +337,17 @@ class OpenAIServer:
         finally:
             span.end(finish_reason=outcome)
 
-    def handle_chat(self, body: dict, send_json, send_stream, trace=None):
+    def handle_chat(self, body: dict, send_json, send_stream, trace=None,
+                    session_id: str | None = None):
         try:
             req = schemas.ChatCompletionRequest.from_dict(body)
         except schemas.ValidationError as e:
             return send_json(422, {"error": {"message": str(e), "type": "invalid_request_error"}})
+        # session-native serving (serve/sessions.py, ISSUE 17): the
+        # X-Session-ID header wins; the body field covers clients that
+        # can't set headers. Ignored entirely on engines without a store.
+        if session_id is None and isinstance(body.get("session_id"), str):
+            session_id = body["session_id"]
 
         engine = self.engine_for(req.model)
         if engine is None:
@@ -402,8 +408,31 @@ class OpenAIServer:
                         kv_entry = store.claim(str(xfer["handoff_id"]))
                     cs.set(found=kv_entry is not None)
                 self.handoff_meter.claim_outcome(kv_entry is not None)
+            # session fleet miss path (serve/sessions.py): an unknown
+            # session on this replica (ring rebalance / replica death
+            # remapped it here) pulls its KV from the pool's handoff
+            # namespace on THIS thread; a lost entry just means a local
+            # re-prefill — counted, never an error
+            sess_store = getattr(engine, "session_store", None)
+            if session_id is not None and sess_store is not None \
+                    and not sess_store.known(session_id):
+                pool = getattr(engine, "handoff", None) or self.handoff
+                if pool is not None:
+                    from llm_in_practise_tpu.serve.sessions import (
+                        session_hid,
+                    )
+
+                    with self.tracer.span("session.pull", parent=span,
+                                          session=session_id) as ps:
+                        pulled = pool.claim(session_hid(session_id))
+                        ps.set(found=pulled is not None)
+                    if pulled is not None:
+                        sess_store.adopt(session_id, pulled)
+                    else:
+                        sess_store.note_lost()
             handle = engine.submit(prompt_ids, params, kv_entry=kv_entry,
-                                   trace=span.context())
+                                   trace=span.context(),
+                                   session_id=session_id)
             req_id = schemas.completion_id()
 
             def queue_full_429(message):
@@ -829,6 +858,40 @@ class OpenAIServer:
         reg.counter_func("llm_local_prefills_total",
                          lambda: eng.local_prefills,
                          "prefills a decode-role replica ran itself")
+        # session-native serving (serve/sessions.py, ISSUE 17): read the
+        # store LIVE at scrape — registered unconditionally so the
+        # metric-docs census and dashboards see one stable family set;
+        # no store → families present, no samples
+        def _sess(reader):
+            def read():
+                st = getattr(eng, "session_store", None)
+                return [] if st is None else reader(st.counters())
+            return read
+
+        reg.gauge_func("llm_sessions_active",
+                       _sess(lambda c: [({}, c["active"])]),
+                       "conversations with server-held KV pinned on "
+                       "this replica")
+        reg.gauge_func("llm_session_pinned_pages",
+                       _sess(lambda c: [({}, c["pinned_pages"])]),
+                       "KV pages refcount-pinned under session handles")
+        reg.counter_func(
+            "llm_session_turns_total",
+            _sess(lambda c: [({"cache": k}, v)
+                             for k, v in sorted(c["turns"].items())]),
+            "finished session turns by admission cache outcome "
+            "(hit / partial / cold)")
+        reg.counter_func(
+            "llm_session_evictions_total",
+            _sess(lambda c: [({"reason": k}, v)
+                             for k, v in sorted(c["evictions"].items())]),
+            "session pin evictions (ttl / pressure / capacity)")
+        reg.counter_func(
+            "llm_session_pulls_total",
+            _sess(lambda c: [({"event": k}, v)
+                             for k, v in sorted(c["pulls"].items())]),
+            "fleet warm-path events (published / publish_failed / "
+            "claimed / lost)")
         # read eng.prefix_cache LIVE at scrape time: benches and serving
         # setups attach/replace the cache after server construction
         # (e.g. tools/tpu_serve_qwen3_bench.py), and the pre-registry
@@ -1036,6 +1099,11 @@ class OpenAIServer:
                         # docs/observability.md "Host timeline")
                         return self._json(
                             200, server.engine.debug_requests())
+                    if self.path == "/debug/sessions":
+                        # server-held conversation pins + fleet pull
+                        # accounting (serve/sessions.py, ISSUE 17)
+                        return self._json(
+                            200, server.engine.debug_sessions())
                     if self.path == "/v1/models":
                         return self._json(200, {
                             "object": "list",
@@ -1073,6 +1141,11 @@ class OpenAIServer:
                 # client) propagates a traceparent header; spans minted
                 # here join that trace instead of starting a new one
                 ctx = parse_traceparent(self.headers.get("traceparent"))
+                # session-native serving (serve/sessions.py): the
+                # conversation handle rides the header (gateway/client)
+                # or the body field — the header wins on conflict, the
+                # same precedence rule traceparent follows
+                sid = self.headers.get("X-Session-ID")
                 try:
                     if self.path == "/v1/embeddings":
                         return server.handle_embeddings(body, self._json)
@@ -1080,7 +1153,7 @@ class OpenAIServer:
                         return server.handle_prefill(body, self._json,
                                                      trace=ctx)
                     return server.handle_chat(body, self._json, self._sse,
-                                              trace=ctx)
+                                              trace=ctx, session_id=sid)
                 except Exception as e:  # noqa: BLE001 — a handler fault must
                     # still answer the client, not drop the connection. If a
                     # response already went out (SSE underway), sending a

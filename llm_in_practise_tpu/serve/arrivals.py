@@ -95,6 +95,99 @@ def describe(schedule: list[Arrival]) -> dict:
     }
 
 
+@dataclasses.dataclass(frozen=True)
+class SessionArrival:
+    """One turn of one conversation in a multi-turn trace: the session
+    identity and turn index ride with the usual offset/length fields so
+    a bench can key routing, build the cumulative prompt, and tell a
+    cold first turn from warm follow-ups."""
+
+    at_s: float
+    session_id: str
+    turn: int                # 0-based within the session
+    n_turns: int             # this session's total turns
+    prompt_tokens: int       # NEW tokens this turn appends
+    max_tokens: int
+    adapter: str | None = None   # tenant (--lora-modules name), if mixed
+
+
+def synthesize_sessions(*, seed: int, n_sessions: int,
+                        turns: tuple[int, int] = (2, 5),
+                        mean_iat_s: float = 0.05, cv: float = 2.0,
+                        think_time_s: tuple[float, float] = (0.05, 0.3),
+                        prompt_tokens: tuple[int, int] = (8, 48),
+                        max_tokens: tuple[int, int] = (8, 32),
+                        adapters: list[str] | None = None,
+                        ) -> list[SessionArrival]:
+    """Seeded multi-turn session trace (ROADMAP item 5's next slice,
+    the driver for ``tools/session_bench.py``).
+
+    Sessions OPEN with the bursty Gamma inter-arrival clock of
+    :func:`synthesize`; each session then runs ``turns`` follow-ups
+    separated by log-uniform think-time gaps — so turns of different
+    sessions interleave and a replica's cache sees unrelated traffic
+    between one conversation's turns (the case session pinning exists
+    for). ``adapters`` assigns each session a tenant round-robin
+    (mixed multi-LoRA traffic); the per-turn ``prompt_tokens`` is the
+    NEW suffix — the caller accumulates the shared prefix, which is
+    what makes follow-ups warm-hittable at all. Returned sorted by
+    ``at_s``: the global arrival order :func:`replay` fires in.
+    """
+    if n_sessions < 1:
+        raise ValueError(f"n_sessions must be >= 1, got {n_sessions}")
+    rng = np.random.default_rng(seed)
+    if cv <= 0 or mean_iat_s == 0:
+        gaps = np.full((n_sessions,), mean_iat_s)
+    else:
+        shape = 1.0 / (cv * cv)
+        gaps = rng.gamma(shape, mean_iat_s / shape, size=n_sessions)
+    opens = np.cumsum(gaps)
+    opens -= opens[0]
+    out: list[SessionArrival] = []
+    lo_t, hi_t = max(1, int(turns[0])), max(1, int(turns[1]))
+    for s in range(n_sessions):
+        n_turns = int(rng.integers(lo_t, hi_t + 1))
+        adapter = (adapters[s % len(adapters)]
+                   if adapters else None)
+        at = float(opens[s])
+        for t in range(n_turns):
+            if t > 0:
+                lo, hi = think_time_s
+                at += float(np.exp(rng.uniform(
+                    np.log(max(lo, 1e-4)), np.log(max(hi, 1e-4)))))
+            out.append(SessionArrival(
+                at_s=at,
+                session_id=f"sess-{seed}-{s}",
+                turn=t, n_turns=n_turns,
+                prompt_tokens=int(rng.integers(
+                    max(1, prompt_tokens[0]),
+                    max(1, prompt_tokens[1]) + 1)),
+                max_tokens=int(rng.integers(
+                    max(1, max_tokens[0]),
+                    max(1, max_tokens[1]) + 1)),
+                adapter=adapter))
+    out.sort(key=lambda a: (a.at_s, a.session_id, a.turn))
+    return out
+
+
+def describe_sessions(schedule: list[SessionArrival]) -> dict:
+    """Artifact block for a session trace (mirrors :func:`describe`)."""
+    sessions = {a.session_id for a in schedule}
+    warm = [a for a in schedule if a.turn > 0]
+    return {
+        "n_sessions": len(sessions),
+        "n_turns": len(schedule),
+        "warm_turns": len(warm),
+        "span_s": round(schedule[-1].at_s, 4) if schedule else 0.0,
+        "turns_per_session_mean": round(
+            len(schedule) / max(1, len(sessions)), 2),
+        "prompt_tokens_mean": round(float(np.mean(
+            [a.prompt_tokens for a in schedule])), 1) if schedule else 0.0,
+        "adapters": sorted({a.adapter for a in schedule
+                            if a.adapter is not None}),
+    }
+
+
 def replay(schedule: list[Arrival], submit, *, workers: int = 8,
            time_scale: float = 1.0, lateness: list | None = None) -> list:
     """Open-loop replay: fire ``submit(arrival)`` at each arrival's

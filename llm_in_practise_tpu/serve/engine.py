@@ -197,6 +197,12 @@ class Request:
     # request whose acquire never did (the too_large fast-reject)
     adapter_ref: bool = dataclasses.field(
         default=False, repr=False, compare=False)
+    # Session-native serving (serve/sessions.py, ISSUE 17): the client's
+    # conversation handle. On finish the slot's full KV pages are pinned
+    # under it in the engine's SessionStore (and published to the fleet
+    # handoff namespace when one is wired) so the next turn starts warm;
+    # admission consults the store's pending fleet pulls under this id.
+    session_id: str | None = None
 
     def cp_add(self, seg: str, dt: float) -> None:
         """Accumulate ``dt`` seconds into critical-path segment ``seg``.
@@ -370,6 +376,7 @@ class InferenceEngine:
         kv_pool_tokens: int | None = None,
         steptrace: StepTrace | None = None,
         adapter_registry=None,
+        session_store=None,
     ):
         # Engine warmup is compile-bound (a 14B engine compiles ~4.5 min
         # of programs through the remote-compile path, round 4); the
@@ -788,6 +795,17 @@ class InferenceEngine:
         self.kv_rejected = 0            # external entries that failed checks
         self.local_prefills = 0         # prefills a decode replica ran
         self._decode_prefill_logged = False
+
+        # Session-native serving (serve/sessions.py, ISSUE 17): requests
+        # carrying a session_id pin their conversation KV across turns —
+        # the store chains into the page pool's reclaim hook (after the
+        # COW index, so sessions yield to active slots) and, with a
+        # handoff store, publishes each finished turn for fleet-wide
+        # migration. Attached AFTER the paged/prefix/handoff wiring
+        # above — attach() reads all three.
+        self.session_store = session_store
+        if session_store is not None:
+            session_store.attach(self)
 
         # SLO goodput thresholds (obs/meter.py GoodputMeter; exported
         # as llm_goodput_tokens_total{slo=…}); the tracer enables
@@ -1843,7 +1861,8 @@ class InferenceEngine:
 
     def submit(self, prompt_ids, params: SamplingParams | None = None, *,
                kv_entry=None, handoff_id: str | None = None,
-               trace=None, adapter: str | None = None) -> Request:
+               trace=None, adapter: str | None = None,
+               session_id: str | None = None) -> Request:
         """``kv_entry`` (optional): a :class:`~.kv_pool.HostEntry` claimed
         from a handoff store — validated and uploaded HERE, on the
         caller's (HTTP) thread, so the engine loop admits it as a pure
@@ -1853,14 +1872,20 @@ class InferenceEngine:
         engine parents this request's phase spans to.
         ``adapter`` (optional): registered LoRA adapter name to decode
         under (serve/multi_lora.py); unknown names raise ValueError on
-        this thread, before anything is queued."""
+        this thread, before anything is queued.
+        ``session_id`` (optional): conversation handle — on finish the
+        turn's KV pages stay pinned under it (serve/sessions.py) and
+        admission consults the session store's pending fleet pulls."""
         params = params or SamplingParams()
         prompt_ids = list(map(int, prompt_ids))
         max_prompt = self.cache_len - 2
         if len(prompt_ids) > max_prompt:  # sliding-window crop (reference
             prompt_ids = prompt_ids[-max_prompt:]  # minigpt/generate.py:18-20)
         req = Request(next(self._uid), prompt_ids, params, engine=self,
-                      handoff_id=handoff_id, trace=trace, adapter=adapter)
+                      handoff_id=handoff_id, trace=trace, adapter=adapter,
+                      session_id=session_id)
+        if session_id is not None and self.session_store is not None:
+            self.session_store.touch(session_id)
         if (self.paged is not None
                 and not self.paged.fits_ever(len(prompt_ids) + 1)):
             # the prompt can NEVER fit the page pool (prompt pages + the
@@ -2642,14 +2667,24 @@ class InferenceEngine:
             self.kv_admitted += 1
             return PagedHit(length=ext.length, entry=ext,
                             last_logits=ext.last_logits, external=True)
-        if self.prefix_cache is None:
-            return None
-        key_ids = self._ns_ids(req.adapter, req.prompt_ids)
-        pages = self.prefix_cache.lookup(key_ids)
+        pages = []
+        if self.prefix_cache is not None:
+            key_ids = self._ns_ids(req.adapter, req.prompt_ids)
+            pages = self.prefix_cache.lookup(key_ids)
+        # a fleet-pulled session entry (serve/sessions.py) outranks a
+        # SHORTER local page hit; when it wins, the pool references the
+        # index lookup took for us are handed straight back
+        if self.session_store is not None and req.session_id is not None:
+            hit = self._session_pull_hit(
+                req, plen, len(pages) * self.paged.page_size)
+            if hit is not None:
+                if pages:
+                    self.paged.pool.release(pages)
+                return hit
         if pages:
             return PagedHit(length=len(pages) * self.paged.page_size,
                             pages=pages)
-        if self.kv_pool is None:
+        if self.kv_pool is None or self.prefix_cache is None:
             return None
 
         def usable(entry) -> bool:
@@ -2684,6 +2719,33 @@ class InferenceEngine:
         return PagedHit(
             length=host.length, entry=host,
             last_logits=host.last_logits if host.length == plen else None)
+
+    def _session_pull_hit(self, req: Request, plen: int, page_len: int):
+        """A usable :class:`~.paged_kv.PagedHit` from the session
+        store's pending fleet pull for this request, or ``None``. The
+        entry rides the tier-entry admission path (host rows scattered
+        into reserved pages), so the SAME fit law applies; consume-once
+        — an entry that loses to a longer page hit or fails the fit
+        law is dropped (the local re-prefill degradation)."""
+        from llm_in_practise_tpu.serve.paged_kv import PagedHit
+
+        pulled = self.session_store.take_pending(req.session_id,
+                                                 req.prompt_ids)
+        if pulled is None:
+            return None
+        host, n = pulled
+        if host.last_logits is None and n >= plen:
+            # no stored logits for the final position: keep one token
+            # to recompute (the page-index hit applies the same cap)
+            n = plen - 1
+        if getattr(host, "slot_axis", 0) != 0 or n <= page_len or n <= 0:
+            return None
+        if n < plen and not (self._oneshot_fits(n, plen - n)
+                             or self._chunked_fits(n, plen - n)):
+            return None
+        return PagedHit(
+            length=n, entry=host,
+            last_logits=host.last_logits if n == plen else None)
 
     def _paged_begin_prefill(self, req: Request, slot: int, plen: int,
                              hit) -> None:
@@ -2762,22 +2824,41 @@ class InferenceEngine:
         logits row. ``req``: books the dispatch into the request's
         critical-path breakdown when given."""
         C = self._bucket_for(len(suffix))
-        with self.steptrace.scope("index_build"):
-            tok = np.zeros((self.max_slots, C), np.int32)
-            tok[slot, :len(suffix)] = suffix
-            W = self._paged_width(done + C)
-            starts = self._paged_index_vec(W, C)
-            starts[slot] = done
-            lens = np.zeros((self.max_slots,), np.int32)
-            lens[slot] = len(suffix)
-            valid = np.zeros((self.max_slots,), np.int32)
-            valid[slot] = len(suffix)
-            self._paged_cow_fork(slot, done, len(suffix))
-            sidx = self.paged.scatter_idx(starts, valid, C)
-            gidx = self.paged.gather_idx(W)
-        # slot-plane adapters: only ``slot``'s row is live, the rest are
-        # dead trash-page windows whose delta doesn't matter
+        # slot-plane adapters: the LoRA chunk program indexes the full
+        # slot plane, so adapters keep the all-slots dispatch; the plain
+        # path runs a SINGLE-ROW chunk — gathering only the owning
+        # slot's pages instead of a W-wide view of every slot, which is
+        # what makes a warm follow-up turn cheaper than its cold
+        # re-prefill (the view gather, not the attention, dominates a
+        # short suffix over a long prefix)
         lora = self._lora_args()
+        one = lora is None
+        with self.steptrace.scope("index_build"):
+            W = self._paged_width(done + C)
+            if one:
+                tok = np.zeros((1, C), np.int32)
+                tok[0, :len(suffix)] = suffix
+                starts = np.array([done], np.int32)
+                lens = np.array([len(suffix)], np.int32)
+                self._paged_cow_fork(slot, done, len(suffix))
+                fs = np.zeros((self.max_slots,), np.int32)
+                fs[slot] = done
+                fv = np.zeros((self.max_slots,), np.int32)
+                fv[slot] = len(suffix)
+                sidx = self.paged.scatter_idx(fs, fv, C)[slot:slot + 1]
+                gidx = self.paged.row_gather_idx(slot, W)
+            else:
+                tok = np.zeros((self.max_slots, C), np.int32)
+                tok[slot, :len(suffix)] = suffix
+                starts = self._paged_index_vec(W, C)
+                starts[slot] = done
+                lens = np.zeros((self.max_slots,), np.int32)
+                lens[slot] = len(suffix)
+                valid = np.zeros((self.max_slots,), np.int32)
+                valid[slot] = len(suffix)
+                self._paged_cow_fork(slot, done, len(suffix))
+                sidx = self.paged.scatter_idx(starts, valid, C)
+                gidx = self.paged.gather_idx(W)
         kw = {} if lora is None else {"lora": lora}
         with self.steptrace.scope("dispatch_wait"):
             t0 = time.monotonic()
@@ -2786,7 +2867,7 @@ class InferenceEngine:
                 self.params, self.paged.kv, jnp.asarray(gidx),
                 jnp.asarray(tok), jnp.asarray(starts), jnp.asarray(lens),
                 jnp.asarray(sidx), **kw)
-            out = last[slot:slot + 1]
+            out = last[0:1] if one else last[slot:slot + 1]
             # force + stamp dt exactly like _prefill_into_slot (the
             # logits feed the first-token sample on this same call path
             # anyway)
@@ -3166,7 +3247,23 @@ class InferenceEngine:
             hist = self.slot_hist[slot]
             if hist:
                 self._paged_register_pages(hist[:-1], slot, req.adapter)
+                if (self.session_store is not None
+                        and req.session_id is not None):
+                    # sessions pin + publish BEFORE release_slot: the
+                    # block table still maps the pages, so the pin's
+                    # share() can never race a refcount-zero free
+                    self._session_note_finish(slot, req, hist[:-1])
             self.paged.release_slot(slot)
+        elif (self.session_store is not None
+                and req.session_id is not None):
+            # contiguous layout: no pages to pin — the store tracks the
+            # conversation's token history and turn accounting only
+            # (warm turns ride the row-based PrefixCache's LRU)
+            hist = self.slot_hist[slot]
+            self.session_store.note_finish(
+                req.session_id, hist[:-1] if hist else req.prompt_ids,
+                [], adapter=req.adapter,
+                cache_outcome=req.cache_outcome)
         # breakdown finalized BEFORE _FINISH is released: a consumer
         # that saw the stream end must find the request in the
         # /debug/requests ring (same ordering rule as the decode span)
@@ -3178,6 +3275,31 @@ class InferenceEngine:
         self.slot_budget[slot] = 0
         self.slot_constraint[slot] = None
         self.slot_adapter[slot] = None
+
+    def _session_note_finish(self, slot: int, req: Request,
+                             token_ids) -> None:
+        """Session pin + fleet publish for a finishing paged slot
+        (serve/sessions.py, ISSUE 17). ``token_ids`` is the KV-valid
+        conversation history (``hist[:-1]`` — the final emitted token's
+        KV was never written). Pins the full-page chain prefix under
+        the session id, then — in fleet mode — gathers a page-aligned
+        copy on THIS thread (the pages are still slot-mapped) and hands
+        it to the store's publisher thread for the device→host copy +
+        pool put, mirroring the disagg publisher split."""
+        P = self.paged.page_size
+        nfull = len(token_ids) // P
+        pages = self.paged.slot_pages(slot)[:nfull] if nfull > 0 else []
+        self.session_store.note_finish(
+            req.session_id, token_ids, pages, adapter=req.adapter,
+            cache_outcome=req.cache_outcome)
+        if self.handoff is not None and nfull > 0:
+            with self.steptrace.scope("publish"):
+                # no last_logits: the entry is a page-aligned PARTIAL
+                # prefix by design — the claiming replica recomputes at
+                # least the suffix, which yields fresh logits
+                entry = self._paged_gather_entry(slot, nfull * P, None)
+            self.session_store.publish(
+                req.session_id, token_ids[:nfull * P], entry)
 
     def _emit(self, slot: int, token_id: int):
         req = self.slot_req[slot]
@@ -4059,6 +4181,10 @@ class InferenceEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        if self.session_store is not None:
+            # drop every session pin (and stop the publisher) so pool
+            # leak checks see only live-slot references after shutdown
+            self.session_store.close()
 
     def is_alive(self) -> bool:
         """True while the engine can still make progress on submitted
@@ -4148,6 +4274,15 @@ class InferenceEngine:
                  self.stats.critical_path_snapshot().items()},
             "finished": out,
         }
+
+    def debug_sessions(self) -> dict:
+        """The ``GET /debug/sessions`` payload (serve/sessions.py) —
+        pinned conversations, turn/eviction/pull accounting. Exists
+        under every configuration so the endpoint never 404s on a
+        replica that happens to run without the store."""
+        if self.session_store is None:
+            return {"enabled": False}
+        return self.session_store.debug_snapshot()
 
     def page_capacity_detail(self, prompt_tokens: int) -> dict:
         """Why a prompt 422s: the page math for the API error body."""

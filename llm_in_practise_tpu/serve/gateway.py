@@ -47,6 +47,7 @@ from llm_in_practise_tpu.serve.http_util import (
     serve_obs_get,
     serve_obs_post,
 )
+from llm_in_practise_tpu.serve.sessions import ConsistentHashRing
 
 
 @dataclass
@@ -186,12 +187,18 @@ class PrefixAffinityRouter(Router):
         self.miss_cost = miss_cost       # pending-units a cache miss "costs"
         self.affinity_ttl_s = affinity_ttl_s
         self.max_sessions = max_sessions
-        # (group, session) -> (ts, upstream id); OrderedDict so eviction is
-        # O(1) LRU instead of a min() scan under the lock. Keyed per group:
-        # a fallback-group pick must not clobber the primary group's pin.
+        # (group, session) -> (ts, upstream base_url); OrderedDict so
+        # eviction is O(1) LRU instead of a min() scan under the lock.
+        # Keyed per group: a fallback-group pick must not clobber the
+        # primary group's pin. The VALUE is the base_url, not
+        # id(upstream): ids are reused by the allocator, so after an
+        # upstream-list change a stale entry could pin a session to an
+        # unrelated replica that happened to inherit the address.
         from collections import OrderedDict
 
-        self._affinity: "OrderedDict[tuple, tuple[float, int]]" = OrderedDict()
+        self._affinity: "OrderedDict[tuple, tuple[float, str]]" = OrderedDict()
+        self._urls: frozenset = frozenset(
+            u.base_url for u in upstreams)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     @staticmethod
@@ -214,30 +221,172 @@ class PrefixAffinityRouter(Router):
         if not cands:
             raise RouterError(f"no available upstream for {group!r}")
         now = time.time()
-        sticky_id = None
+        sticky_url = None
         if key is not None:
             with self._lock:
+                # topology change: drop pins whose replica left the
+                # list — a stale pin must not bias the score toward a
+                # new upstream that reused the address slot
+                urls = frozenset(u.base_url for u in self.upstreams)
+                if urls != self._urls:
+                    self._urls = urls
+                    for k in [k for k, v in self._affinity.items()
+                              if v[1] not in urls]:
+                        del self._affinity[k]
                 hit = self._affinity.get(key)
                 if hit and now - hit[0] < self.affinity_ttl_s:
-                    sticky_id = hit[1]
+                    sticky_url = hit[1]
 
         def score(u: Upstream) -> tuple:
             load = (u.pending + 1) / max(u.weight, 1e-9)
-            miss = 0.0 if id(u) == sticky_id else self.miss_cost
+            miss = 0.0 if u.base_url == sticky_url else self.miss_cost
             return (load + miss, u.served / max(u.weight, 1e-9))
 
         chosen = min(cands, key=score)
         with chosen.lock:
             chosen.picks += 1
-            if id(chosen) == sticky_id:
+            if chosen.base_url == sticky_url:
                 chosen.affinity_hits += 1
         if key is not None:
             with self._lock:
-                self._affinity[key] = (now, id(chosen))
+                self._affinity[key] = (now, chosen.base_url)
                 self._affinity.move_to_end(key)
                 if len(self._affinity) > self.max_sessions:
                     self._affinity.popitem(last=False)
         return chosen
+
+
+class HashRingRouter(Router):
+    """Session-affine routing on a consistent-hash ring — the nginx
+    ``hash $http_x_session_id consistent`` / llm-d session-ring idea
+    (``08-LLM-Router``), replacing :class:`PrefixAffinityRouter`'s
+    sticky table for session-native serving (serve/sessions.py).
+
+    Ownership is a pure function of (key, live topology): every
+    gateway replica computes the same owner with no shared state, and
+    a replica join/leave remaps only ~1/N sessions (the dead node's
+    arcs) instead of whatever a table happened to pin — the surviving
+    replicas' pinned session KV stays exactly where it is. The routing
+    key is the strongest identity available: explicit session id >
+    conversation-prefix hash > tenant/adapter name, so a tenant's
+    requests concentrate where its adapter banks and COW chains are
+    already resident.
+
+    Bounded-load two-choice keeps one hot session from melting its
+    owner: when the owner's pending load exceeds ``bound`` × the group
+    mean, the request overflows to the key's SECOND ring owner (still
+    deterministic — the same replica every time, so ITS cache warms
+    too), and only past that to plain least-pending. Cooled-down or
+    excluded owners are skipped by walking the ring's successor order,
+    no rebuild — when the replica comes back, its sessions come home.
+    """
+
+    def __init__(self, upstreams: list[Upstream], *,
+                 bound: float = 1.25, vnodes: int = 64,
+                 max_tracked: int = 4096):
+        super().__init__(upstreams)
+        self.bound = float(bound)
+        self.vnodes = int(vnodes)
+        self.max_tracked = int(max_tracked)
+        from collections import OrderedDict
+
+        self._lock = threading.Lock()
+        self._rings: dict[str, ConsistentHashRing] = {}  # guarded-by: _lock
+        self._topology: frozenset | None = None          # guarded-by: _lock
+        # key -> base_url last served by: REMAP ACCOUNTING only (the
+        # ring itself is memoryless); bounded LRU like the old sticky
+        # table, but losing an entry only loses a metric sample
+        self._last_owner: "OrderedDict[tuple, str]" = OrderedDict()  # guarded-by: _lock
+        self.ring_picks = {"primary": 0, "second": 0,
+                           "fallback": 0}                # guarded-by: _lock
+        self.ring_rebuilds = 0                           # guarded-by: _lock
+        self.ring_remapped = 0                           # guarded-by: _lock
+
+    @staticmethod
+    def ring_key(body: dict) -> str | None:
+        """Strongest stable identity in the request, namespaced so the
+        three sources can never collide with each other."""
+        body = body or {}
+        sid = body.get("session_id")
+        if isinstance(sid, str) and sid:
+            return "sid:" + sid
+        pfx = PrefixAffinityRouter.session_key(body)
+        if pfx is not None:
+            return "pfx:" + pfx
+        model = body.get("model")
+        return ("tenant:" + str(model)) if model else None
+
+    def _ring_for(self, group: str) -> ConsistentHashRing:
+        """Per-group ring, rebuilt ONLY when the upstream set actually
+        changed (compared as (group, base_url) pairs — weight or
+        cooldown churn must not move sessions)."""
+        topo = frozenset((u.group, u.base_url) for u in self.upstreams)
+        with self._lock:
+            if topo != self._topology:
+                if self._topology is not None:
+                    self.ring_rebuilds += 1
+                self._topology = topo
+                self._rings = {}
+            ring = self._rings.get(group)
+            if ring is None:
+                ring = ConsistentHashRing(
+                    [u.base_url for u in self.upstreams
+                     if u.group == group],
+                    vnodes=self.vnodes)
+                self._rings[group] = ring
+            return ring
+
+    def pick_for_request(self, group: str, body: dict,
+                         exclude: set[int] = frozenset()) -> Upstream:
+        cands = [u for u in self.candidates(group) if id(u) not in exclude]
+        if not cands:
+            raise RouterError(f"no available upstream for {group!r}")
+        key = self.ring_key(body)
+        if key is None:
+            return self._least_pending(cands)
+        ring = self._ring_for(group)
+        by_url = {u.base_url: u for u in cands}
+        # successor walk = cooldown/exclude skipping without a rebuild
+        walk = [by_url[u] for u in ring.owners(key, len(ring) or 1)
+                if u in by_url]
+        avg = sum(u.pending for u in cands) / len(cands)
+        limit = self.bound * (avg + 1.0)
+        chosen, choice = None, "fallback"
+        for rank, u in zip(("primary", "second"), walk):
+            if u.pending + 1 <= limit:
+                chosen, choice = u, rank
+                break
+        if chosen is None:
+            # both choice owners over the load bound (or none alive):
+            # spill anywhere — losing affinity beats queueing
+            chosen = min(cands, key=lambda u: (
+                (u.pending + 1) / max(u.weight, 1e-9),
+                u.served / max(u.weight, 1e-9)))
+        with self._lock:
+            prev = self._last_owner.get((group, key))
+            if prev is not None and prev != chosen.base_url:
+                self.ring_remapped += 1
+            self._last_owner[(group, key)] = chosen.base_url
+            self._last_owner.move_to_end((group, key))
+            if len(self._last_owner) > self.max_tracked:
+                self._last_owner.popitem(last=False)
+            self.ring_picks[choice] += 1
+        with chosen.lock:
+            chosen.picks += 1
+            if prev == chosen.base_url:
+                chosen.affinity_hits += 1
+        return chosen
+
+    def ring_snapshot(self) -> dict:
+        """Ring counters read under the lock — the scrape callbacks'
+        one entry point (mirrors Gateway._counter_snapshot)."""
+        with self._lock:
+            return {
+                "picks": dict(self.ring_picks),
+                "rebuilds": self.ring_rebuilds,
+                "remapped": self.ring_remapped,
+                "tracked": len(self._last_owner),
+            }
 
 
 class DisaggRouter(Router):
@@ -1062,6 +1211,33 @@ class Gateway:
         reg.counter_func("gateway_upstream_affinity_hits_total",
                          per_upstream(lambda u: u.affinity_hits))
 
+        # session ring (HashRingRouter, ISSUE 17): registered
+        # unconditionally — other router classes have no ring_snapshot,
+        # so the families are present with no samples, and the
+        # metric-docs census sees one stable set either way
+        def _ring(read_one):
+            def collect():
+                snap = getattr(self.router, "ring_snapshot", None)
+                return [] if snap is None else read_one(snap())
+            return collect
+
+        reg.counter_func(
+            "gateway_ring_picks_total",
+            _ring(lambda s: [({"choice": k}, v)
+                             for k, v in sorted(s["picks"].items())]),
+            "ring routing decisions (primary owner / bounded-load "
+            "second choice / least-pending fallback)")
+        reg.counter_func("gateway_ring_rebuilds_total",
+                         _ring(lambda s: [({}, s["rebuilds"])]),
+                         "ring rebuilds on upstream topology change")
+        reg.counter_func("gateway_ring_remapped_total",
+                         _ring(lambda s: [({}, s["remapped"])]),
+                         "tracked keys whose owner changed between "
+                         "consecutive picks (~1/N per join/leave)")
+        reg.gauge_func("gateway_ring_sessions_tracked",
+                       _ring(lambda s: [({}, s["tracked"])]),
+                       "keys in the remap-accounting LRU window")
+
         # per-tenant fairness plane (multi-LoRA serving, ISSUE 15):
         # registered unconditionally — tenants appear as they first
         # route; without quotas the rejection/balance families render
@@ -1128,6 +1304,13 @@ class Gateway:
                 if serve_obs_post(self, body):
                     return None
                 stream = bool(body.get("stream"))
+                # the session id rides INTO the body: one field serves
+                # the ring key here AND the replica's SessionStore after
+                # the forward (headers don't survive _forward; the body
+                # does)
+                sid = self.headers.get("X-Session-ID")
+                if sid and not body.get("session_id"):
+                    body["session_id"] = sid
                 ctx = parse_traceparent(self.headers.get("traceparent"))
                 try:
                     status, resp = gw.handle_completion(body, stream=stream,

@@ -77,6 +77,12 @@ class HostEntry:
     # page_size 16 where the bucket path shipped 256). 0 = legacy
     # bucket-width entry. Consumers of either layout accept both.
     page_size: int = 0
+    # Session-handoff entries carry the token ids the rows were computed
+    # for: a claiming engine has only a session id, not the producer's
+    # prompt, so prefix validation on the consumer side needs the tokens
+    # on the wire. None = legacy entry (prefix-keyed pools key by token
+    # tuple already, so the field would be redundant there).
+    token_ids: list | None = None
 
     @property
     def pages(self) -> int:
@@ -167,17 +173,26 @@ def encode_entry(host: HostEntry) -> bytes:
                                 "dtype": arr.dtype.name}
             arrays.append(arr)
         manifest_rows.append(layer_meta)
-    logits = np.ascontiguousarray(host.last_logits)
+    # session-published entries are page-aligned partials WITHOUT final
+    # logits (the consumer recomputes the last position) — a null
+    # manifest slot, not a zero-length array
+    logits = (None if host.last_logits is None
+              else np.ascontiguousarray(host.last_logits))
     manifest = {
         "length": host.length,
         "bucket": host.bucket,
         "slot_axis": host.slot_axis,
         "page_size": host.page_size,
         "rows": manifest_rows,
-        "last_logits": {"shape": list(logits.shape),
-                        "dtype": logits.dtype.name},
+        "last_logits": None if logits is None else
+        {"shape": list(logits.shape), "dtype": logits.dtype.name},
     }
-    arrays.append(logits)
+    if host.token_ids is not None:
+        # optional key: absent for legacy entries, so old decoders (and
+        # old blobs through new decoders) interop unchanged
+        manifest["token_ids"] = [int(t) for t in host.token_ids]
+    if logits is not None:
+        arrays.append(logits)
     head = json.dumps(manifest).encode()
     return b"".join([struct.pack("<I", len(head)), head,
                      *(a.tobytes() for a in arrays)])
@@ -199,10 +214,13 @@ def decode_entry(blob: bytes) -> HostEntry:
 
     rows = [{name: take(meta) for name, meta in sorted(layer.items())}
             for layer in manifest["rows"]]
+    lmeta = manifest["last_logits"]
     return HostEntry(length=manifest["length"], bucket=manifest["bucket"],
                      slot_axis=int(manifest.get("slot_axis", 0)),
                      page_size=int(manifest.get("page_size", 0)),
-                     rows=rows, last_logits=take(manifest["last_logits"]))
+                     rows=rows,
+                     last_logits=take(lmeta) if lmeta is not None else None,
+                     token_ids=manifest.get("token_ids"))
 
 
 # --- L2: host-RAM pool ------------------------------------------------------
