@@ -759,6 +759,16 @@ def obs_snapshot(server=None, engine=None) -> dict:
     WHERE the time went; one without it is a wall-clock guess."""
     snap = {}
     try:
+        from llm_in_practise_tpu.obs.buildinfo import build_info
+
+        # what code produced this artifact (obs/buildinfo.py) — the
+        # same identity the servers expose as llm_build_info, so a
+        # BENCH_*.json is comparable against the fleet that ran it
+        snap["build_info"] = build_info()
+    except Exception as e:  # noqa: BLE001 — identity is metadata; its
+        # failure must not kill the artifact
+        snap["build_info_error"] = f"{type(e).__name__}: {e}"
+    try:
         from llm_in_practise_tpu.obs.trace import get_tracer
 
         snap["trace_summary"] = get_tracer().summary()

@@ -124,6 +124,23 @@ def main():
                    type=float, default=60.0, metavar="SECONDS",
                    help="token buckets refill their full capacity over "
                         "this window")
+    p.add_argument("--canary", action="append", default=[],
+                   metavar="URL=WEIGHT",
+                   help="repeatable: weighted canary leg — WEIGHT "
+                        "fraction (0..1) of admitted traffic forwards "
+                        "to URL instead of the stable pool; a failed "
+                        "canary call falls back to the stable path. "
+                        "GET /fleet scores the leg's build version "
+                        "against the stable majority and returns a "
+                        "promote/rollback verdict "
+                        "(docs/observability.md fleet plane)")
+    p.add_argument("--canary-golden-rate", dest="canary_golden_rate",
+                   type=float, default=0.0, metavar="FRACTION",
+                   help="shadow-sample this fraction of deterministic "
+                        "(temperature=0, non-stream) canary hits "
+                        "against a stable upstream and compare the "
+                        "answers token-for-token; any mismatch drives "
+                        "the /fleet verdict to rollback")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=4000)
     args = p.parse_args()
@@ -184,6 +201,19 @@ def main():
         if t not in tenant_quotas:
             p.error(f"--tenant-weight {t!r} has no matching --tenant-quota")
 
+    canary = {}
+    for spec in args.canary:
+        url, eq, w = spec.rpartition("=")
+        try:
+            canary[url] = float(w)
+        except ValueError:
+            url = ""
+        if not url or not eq or not 0.0 < canary.get(url, 0.0) <= 1.0:
+            p.error(f"invalid --canary {spec!r} "
+                    "(want URL=WEIGHT with 0 < WEIGHT <= 1)")
+    if sum(canary.values()) > 1.0:
+        p.error("--canary weights sum above 1.0 — no stable traffic left")
+
     if args.routing == "ring":
         router = HashRingRouter(upstreams, bound=args.ring_bound)
     elif args.routing == "prefix_aware":
@@ -204,6 +234,8 @@ def main():
         tenant_quotas=tenant_quotas or None,
         tenant_weights=tenant_weights or None,
         tenant_quota_window_s=args.tenant_quota_window,
+        canary=canary or None,
+        canary_golden_rate=args.canary_golden_rate,
     )
     scalers = []
     if args.autoscale:
@@ -249,8 +281,13 @@ def main():
         w = tenant_weights.get(t, 1.0)
         print(f"tenant {t}: {q * w:g} tokens / "
               f"{args.tenant_quota_window:g}s (weight {w:g})")
+    for url, w in sorted(canary.items()):
+        print(f"canary {url}: {w:.0%} of traffic"
+              + (f", golden rate {args.canary_golden_rate:g}"
+                 if args.canary_golden_rate else ""))
     print(f"gateway on {args.host}:{args.port} "
-          f"(/v1/chat/completions, /health, /metrics, /debug/traces)")
+          f"(/v1/chat/completions, /health, /metrics, /debug/traces, "
+          f"/fleet)")
     try:
         gw.serve(host=args.host, port=args.port)
     finally:

@@ -28,6 +28,17 @@ from llm_in_practise_tpu.obs.debug import (  # noqa: F401
     seed_everything,
     tap,
 )
+from llm_in_practise_tpu.obs.buildinfo import (  # noqa: F401
+    build_info,
+    config_fingerprint,
+    register_build_info,
+)
+from llm_in_practise_tpu.obs.fleet import (  # noqa: F401
+    FleetCollector,
+    canary_verdict,
+    stitch_perfetto,
+    write_perfetto,
+)
 from llm_in_practise_tpu.obs.meter import (  # noqa: F401
     DispatchMeter,
     EpochTimer,

@@ -1,0 +1,578 @@
+"""Fleet observability plane: reset-safe metrics federation + scoreboard.
+
+Every observability layer so far stops at one process — the span ring
+(obs/trace.py), the device plane (obs/cost.py), the host-gap flight
+recorder (obs/steptrace.py) all answer "what is THIS replica doing".
+This module is the fleet answer: a collector that scrapes every
+replica's ``/metrics``, ``/debug/requests``, and ``/debug/traces``,
+merges counter families across replicas, and computes one scoreboard —
+SLO attainment and goodput blame split by critical-path segment,
+tenant, session cache state, and **build version**
+(``llm_build_info``, obs/buildinfo.py). The per-version comparison is
+the canary verdict (:meth:`FleetCollector.canary_verdict`) that drives
+the gateway's weighted canary routing (serve/gateway.py ``--canary``).
+
+**Reset-safe federation.** Counters are cumulative per *process
+incarnation*: a replica that restarts mid-window starts every counter
+back at zero. A naive fleet sum then goes BACKWARD (a negative rate on
+a counter — the exact artifact ``tests/promparse.py``'s monotonicity
+check exists to flag), silently losing everything the dead incarnation
+had counted. The collector keeps a per-series ledger per replica:
+
+- ``last`` — the newest scraped cumulative value;
+- ``base`` — the resync base: every time a scraped value *decreases*
+  (the Prometheus ``rate()``/``increase()`` reset rule), the pre-reset
+  ``last`` folds into ``base`` and the event is counted as a restart.
+
+A series' fleet contribution is always ``base + last``, so a restart
+registers as a **counter reset + delta resync** — never a negative
+delta, never a silent undercount. A replica that *disappears from the
+scrape set* keeps contributing its frozen ``base + last`` (its work
+happened; only its future is gone) and is reported ``up=False``.
+
+Two documented limits, the same ones Prometheus itself has: counts
+made between the last successful scrape and the death are lost (poll
+often, or poll-before-drain like ``tools/fleet_bench.py`` does), and a
+restart is undetectable if the new incarnation's value has already
+overtaken the old one at first scrape (monotone ambiguity).
+
+**Perfetto stitching.** :func:`stitch_perfetto` merges every server's
+``/debug/traces`` ring into one Chrome-JSON trace keyed on trace id —
+one replica per Perfetto process row, spans deduplicated on
+``(trace_id, span_id)`` (colocated servers share one process tracer
+ring, so the same span shows up in several scrapes).
+
+Surfaces: ``GET /fleet`` on the gateway, ``tools/fleet_report.py``
+(one-shot table + ``--perfetto``), ``tools/fleet_bench.py`` (the
+BENCH_FLEET artifact with the reconciliation and verdict gates).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+
+__all__ = [
+    "FleetCollector",
+    "ParsedFamily",
+    "canary_verdict",
+    "parse_exposition",
+    "stitch_perfetto",
+    "write_perfetto",
+]
+
+# value decreases below this are resets; above-zero slack absorbs float
+# rendering jitter on seconds-valued counters
+_RESET_EPS = 1e-9
+
+_TYPE_RE = re.compile(
+    r"^#\s+TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+(\w+)\s*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+class ParsedFamily:
+    """One scraped family: ``kind`` plus ``samples`` keyed on
+    ``(sample_name, tuple(sorted(label items)))`` → float."""
+
+    __slots__ = ("name", "kind", "samples")
+
+    def __init__(self, name: str, kind: str):
+        self.name = name
+        self.kind = kind
+        self.samples: dict[tuple, float] = {}
+
+
+def _unescape(raw: str) -> str:
+    return (raw.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str) -> dict[str, ParsedFamily]:
+    """Parse a Prometheus text exposition into families.
+
+    Deliberately *tolerant* where ``tests/promparse.py`` is strict
+    (that parser PINS our own renderer; this one reads whatever a
+    fleet member serves — ours today, a vLLM replica tomorrow):
+    unknown comment lines and samples without a preceding ``# TYPE``
+    are kept as ``untyped`` instead of raising — an undeclared family
+    must degrade to "not summed as a counter", never to a dead scrape.
+    """
+    families: dict[str, ParsedFamily] = {}
+    for line in text.split("\n"):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if m and m.group(1) not in families:
+                families[m.group(1)] = ParsedFamily(m.group(1), m.group(2))
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        sname, rawlabels, rawvalue = m.group(1), m.group(2), m.group(3)
+        try:
+            value = float(rawvalue)
+        except ValueError:
+            continue
+        if value != value:          # NaN never merges meaningfully
+            continue
+        fam = families.get(sname)
+        if fam is None:
+            for suffix in ("_bucket", "_count", "_sum"):
+                if sname.endswith(suffix):
+                    fam = families.get(sname[: -len(suffix)])
+                    if fam is not None and fam.kind != "histogram":
+                        fam = None
+                    if fam is not None:
+                        break
+        if fam is None:
+            fam = families.setdefault(sname, ParsedFamily(sname, "untyped"))
+        labels = (tuple(sorted((k, _unescape(v)) for k, v in
+                               _LABEL_RE.findall(rawlabels)))
+                  if rawlabels else ())
+        fam.samples[(sname, labels)] = value
+    return families
+
+
+class _ReplicaLedger:
+    """Per-replica scrape state. All fields are owned by the collector
+    and only touched under its lock (one writer at a time; readers
+    snapshot)."""
+
+    __slots__ = ("url", "up", "build", "kinds", "last", "base", "gauges",
+                 "resets", "series_resyncs", "scrape_failures", "polls",
+                 "debug_requests", "traces")
+
+    def __init__(self, url: str):
+        self.url = url
+        self.up = False
+        self.build: dict | None = None
+        self.kinds: dict[str, str] = {}
+        self.last: dict[tuple, float] = {}    # counter series, newest
+        self.base: dict[tuple, float] = {}    # pre-reset resync bases
+        self.gauges: dict[tuple, float] = {}
+        self.resets = 0            # restart events detected
+        self.series_resyncs = 0    # individual series that resynced
+        self.scrape_failures = 0
+        self.polls = 0
+        self.debug_requests: dict | None = None
+        self.traces: dict | None = None
+
+
+def _http_fetch(base_url: str, path: str, timeout_s: float = 5.0) -> str:
+    with urllib.request.urlopen(base_url.rstrip("/") + path,
+                                timeout=timeout_s) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def _frac(ok: float, bad: float) -> float | None:
+    total = ok + bad
+    return round(ok / total, 6) if total > 0 else None
+
+
+class FleetCollector:
+    """Scrape a set of replicas and maintain the reset-safe fleet
+    ledger. ``targets`` are base URLs; ``fetch(url, path) -> str`` is
+    pluggable so in-process fleets (benches, the gateway's own tests)
+    scrape without HTTP. ``debug=False`` skips the ``/debug/requests``
+    + ``/debug/traces`` pulls (a bare metrics federator)."""
+
+    def __init__(self, targets: list[str], *, fetch=None,
+                 timeout_s: float = 5.0, debug: bool = True):
+        self._fetch = fetch or (
+            lambda url, path: _http_fetch(url, path, timeout_s))
+        self.debug = debug
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _ReplicaLedger] = {}  # guarded-by: _lock
+        for url in targets:
+            self._replicas[url] = _ReplicaLedger(url)
+        self.polls = 0               # guarded-by: _lock
+        # fleet totals that went backward across polls — the ledger
+        # makes this impossible by construction, so a nonzero value
+        # means a collector bug; exported so the bench can gate on it
+        self.negative_deltas = 0     # guarded-by: _lock
+        self._prev_totals: dict[tuple, float] = {}  # guarded-by: _lock
+
+    # -- scraping -------------------------------------------------------------
+
+    def add_target(self, url: str) -> None:
+        with self._lock:
+            self._replicas.setdefault(url, _ReplicaLedger(url))
+
+    def poll(self) -> dict:
+        """Scrape every target once and merge. Network I/O runs outside
+        the lock; the ledger merge is one short critical section."""
+        with self._lock:
+            urls = list(self._replicas)
+        scraped: dict[str, dict] = {}
+        for url in urls:
+            got: dict = {}
+            try:
+                got["families"] = parse_exposition(
+                    self._fetch(url, "/metrics"))
+            except Exception as e:  # noqa: BLE001 — a dead replica is a
+                # data point (up=False), never a dead poll
+                got["error"] = f"{type(e).__name__}: {e}"
+            if "error" not in got and self.debug:
+                for key, path in (("debug_requests", "/debug/requests"),
+                                  ("traces", "/debug/traces")):
+                    try:
+                        raw = self._fetch(url, path)
+                        got[key] = (raw if isinstance(raw, dict)
+                                    else json.loads(raw))
+                    except Exception:  # noqa: BLE001 — optional planes;
+                        # a replica without them still federates
+                        got[key] = None
+            scraped[url] = got
+        with self._lock:
+            self.polls += 1
+            for url, got in scraped.items():
+                self._merge_one(self._replicas[url], got)
+            totals = self._fleet_totals_locked()
+            for key, value in self._prev_totals.items():
+                if totals.get(key, 0.0) < value - _RESET_EPS:
+                    self.negative_deltas += 1
+            self._prev_totals = totals
+            return self._status_locked()
+
+    def _merge_one(self, led: _ReplicaLedger, got: dict) -> None:
+        if "error" in got:
+            led.up = False
+            led.scrape_failures += 1
+            return
+        led.up = True
+        led.polls += 1
+        led.debug_requests = got.get("debug_requests")
+        led.traces = got.get("traces")
+        families = got["families"]
+        info = families.get("llm_build_info")
+        if info is not None and info.samples:
+            (_, labels), _val = next(iter(sorted(info.samples.items())))
+            led.build = dict(labels)
+        reset_this_poll = False
+        for fam in families.values():
+            led.kinds[fam.name] = fam.kind
+            if fam.kind == "counter":
+                for key, value in fam.samples.items():
+                    prev = led.last.get(key)
+                    if prev is not None and value < prev - _RESET_EPS:
+                        # restart: fold the dead incarnation's total
+                        # into the resync base — the fleet sum keeps it
+                        led.base[key] = led.base.get(key, 0.0) + prev
+                        led.series_resyncs += 1
+                        reset_this_poll = True
+                    led.last[key] = value
+            else:
+                for key, value in fam.samples.items():
+                    led.gauges[key] = value
+        if reset_this_poll:
+            led.resets += 1
+
+    # -- merged views ---------------------------------------------------------
+
+    def _fleet_totals_locked(self) -> dict[tuple, float]:
+        totals: dict[tuple, float] = {}
+        for led in self._replicas.values():
+            for key, value in led.last.items():
+                totals[key] = (totals.get(key, 0.0) + value
+                               + led.base.get(key, 0.0))
+        return totals
+
+    def fleet_counter(self, family: str) -> dict[tuple, float]:
+        """Fleet totals for one counter family: label-tuple → sum of
+        every replica's ``base + last`` (down replicas included)."""
+        with self._lock:
+            out: dict[tuple, float] = {}
+            for led in self._replicas.values():
+                for (sname, labels), value in led.last.items():
+                    if sname != family:
+                        continue
+                    out[labels] = (out.get(labels, 0.0) + value
+                                   + led.base.get((sname, labels), 0.0))
+            return out
+
+    def _label_split(self, family: str, label: str) -> dict[str, float]:
+        merged: dict[str, float] = {}
+        for labels, value in self.fleet_counter(family).items():
+            got = dict(labels).get(label)
+            if got is not None:
+                merged[got] = merged.get(got, 0.0) + value
+        return merged
+
+    def _status_locked(self) -> dict:
+        return {
+            "polls": self.polls,
+            "replicas": {
+                led.url: {"up": led.up, "resets": led.resets,
+                          "scrape_failures": led.scrape_failures}
+                for led in self._replicas.values()},
+            "counter_resets": sum(r.resets
+                                  for r in self._replicas.values()),
+            "negative_deltas": self.negative_deltas,
+        }
+
+    def traces_by_replica(self) -> dict[str, dict]:
+        """Each up replica's last ``/debug/traces`` payload — the input
+        :func:`stitch_perfetto` merges into one fleet trace."""
+        with self._lock:
+            return {led.url: led.traces
+                    for led in self._replicas.values() if led.traces}
+
+    def replicas(self) -> list[dict]:
+        with self._lock:
+            return [{
+                "url": led.url,
+                "up": led.up,
+                "polls": led.polls,
+                "scrape_failures": led.scrape_failures,
+                "resets": led.resets,
+                "series_resyncs": led.series_resyncs,
+                "version": (led.build or {}).get("version", "unknown"),
+                "git_sha": (led.build or {}).get("git_sha", "unknown"),
+                "config_hash": (led.build or {}).get("config_hash",
+                                                     "unknown"),
+            } for led in self._replicas.values()]
+
+    # -- the scoreboard -------------------------------------------------------
+
+    def scoreboard(self) -> dict:
+        """The fleet answer: SLO attainment + goodput blame split by
+        critical-path segment, tenant, session cache state, and build
+        version — every split a reset-safe fleet counter rollup."""
+        replicas = self.replicas()
+        slo_req = self._label_split("llm_slo_requests_total", "slo")
+        goodput = self._label_split("llm_goodput_tokens_total", "slo")
+        tenants: dict[str, dict] = {}
+        for tenant, v in self._label_split("gateway_tenant_tokens_total",
+                                           "tenant").items():
+            tenants.setdefault(tenant, {})["tokens"] = v
+        for labels, v in self.fleet_counter(
+                "gateway_tenant_goodput_tokens_total").items():
+            d = dict(labels)
+            if "tenant" in d and "slo" in d:
+                tenants.setdefault(d["tenant"], {})[
+                    "tokens_" + d["slo"]] = v
+        with self._lock:
+            negative_deltas = self.negative_deltas
+        board = {
+            "replicas": replicas,
+            "up": sum(1 for r in replicas if r["up"]),
+            "counter_resets": sum(r["resets"] for r in replicas),
+            "negative_deltas": negative_deltas,
+            "slo": {
+                "requests_ok": slo_req.get("ok", 0.0),
+                "requests_violated": slo_req.get("violated", 0.0),
+                "attainment": _frac(slo_req.get("ok", 0.0),
+                                    slo_req.get("violated", 0.0)),
+                "tokens_ok": goodput.get("ok", 0.0),
+                "tokens_violated": goodput.get("violated", 0.0),
+                "goodput_fraction": _frac(goodput.get("ok", 0.0),
+                                          goodput.get("violated", 0.0)),
+            },
+            "blame": self._label_split("llm_slo_blame_total", "phase"),
+            "critical_path_seconds": {
+                k: round(v, 6) for k, v in self._label_split(
+                    "llm_request_critical_path_seconds_total",
+                    "segment").items()},
+            "tenants": tenants,
+            "session_turns": self._label_split("llm_session_turns_total",
+                                               "cache"),
+            "tokens_generated": sum(self.fleet_counter(
+                "llm_tokens_generated_total").values()),
+            "requests": sum(self.fleet_counter(
+                "llm_requests_total").values()),
+            "by_version": self._by_version(),
+        }
+        recent = self._recent_requests()
+        if recent is not None:
+            board["recent"] = recent
+        return board
+
+    def _by_version(self) -> dict[str, dict]:
+        """Per-build-version rollup — the canary verdict's input. Each
+        replica's goodput/SLO contribution (``base + last``) books to
+        the version its ``llm_build_info`` declares."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for led in self._replicas.values():
+                version = (led.build or {}).get("version", "unknown")
+                v = out.setdefault(version, {
+                    "replicas": [], "requests_ok": 0.0,
+                    "requests_violated": 0.0, "tokens_ok": 0.0,
+                    "tokens_violated": 0.0, "tokens_generated": 0.0,
+                    "resets": 0})
+                v["replicas"].append(led.url)
+                v["resets"] += led.resets
+                for (sname, labels), value in led.last.items():
+                    value += led.base.get((sname, labels), 0.0)
+                    slo = dict(labels).get("slo")
+                    if sname == "llm_slo_requests_total" and slo:
+                        v["requests_" + slo] += value
+                    elif sname == "llm_goodput_tokens_total" and slo:
+                        v["tokens_" + slo] += value
+                    elif sname == "llm_tokens_generated_total":
+                        v["tokens_generated"] += value
+        for v in out.values():
+            v["attainment"] = _frac(v["requests_ok"],
+                                    v["requests_violated"])
+            v["goodput_fraction"] = _frac(v["tokens_ok"],
+                                          v["tokens_violated"])
+        return out
+
+    def _recent_requests(self) -> dict | None:
+        """Fleet view of the replicas' ``/debug/requests`` rings: the
+        recent-finished window by cache outcome (per-request detail
+        the counter families cannot carry)."""
+        with self._lock:
+            payloads = [led.debug_requests for led in
+                        self._replicas.values() if led.debug_requests]
+        if not payloads:
+            return None
+        by_cache: dict[str, int] = {}
+        ttfts: list[float] = []
+        for p in payloads:
+            for r in p.get("finished", []):
+                outcome = str(r.get("cache") or "unknown")
+                by_cache[outcome] = by_cache.get(outcome, 0) + 1
+                if r.get("ttft_s") is not None:
+                    ttfts.append(float(r["ttft_s"]))
+        ttfts.sort()
+        return {
+            "finished": sum(by_cache.values()),
+            "by_cache": by_cache,
+            "ttft_p50_s": (round(ttfts[len(ttfts) // 2], 6)
+                           if ttfts else None),
+        }
+
+    # -- canary verdict -------------------------------------------------------
+
+    def canary_verdict(self, *, baseline: str, canary: str,
+                       golden: dict | None = None,
+                       margin: float = 0.05,
+                       min_requests: int = 1) -> dict:
+        """Promotion/rollback decision for ``canary`` vs ``baseline``
+        (both ``llm_build_info`` version labels) — see
+        :func:`canary_verdict` for the rules."""
+        return canary_verdict(self._by_version(), baseline=baseline,
+                              canary=canary, golden=golden,
+                              margin=margin, min_requests=min_requests)
+
+
+def canary_verdict(by_version: dict[str, dict], *, baseline: str,
+                   canary: str, golden: dict | None = None,
+                   margin: float = 0.05, min_requests: int = 1) -> dict:
+    """The canary decision, ROADMAP 5(c)'s measurement half.
+
+    Inputs: the scoreboard's per-version rollup, plus an optional
+    golden-token comparison ``{"samples": n, "mismatches": m}`` (the
+    gateway's shadow sampling, or a bench's paired greedy probes).
+
+    Rules, first match wins:
+
+    - either leg below ``min_requests`` finished requests →
+      ``inconclusive`` (don't promote OR roll back on noise);
+    - any golden-token mismatch → ``rollback`` (wrong output is never
+      a latency tradeoff);
+    - canary goodput fraction more than ``margin`` below baseline's →
+      ``rollback`` (absolute margin on the ok-token share; with SLO
+      accounting off both fractions are None and the check is skipped);
+    - otherwise → ``promote``.
+    """
+    b, c = by_version.get(baseline), by_version.get(canary)
+    verdict = {"baseline": baseline, "canary": canary,
+               "margin": margin, "golden": golden, "reasons": []}
+
+    def done(decision: str) -> dict:
+        verdict["verdict"] = decision
+        return verdict
+
+    for name, leg in (("baseline", b), ("canary", c)):
+        n = (leg["requests_ok"] + leg["requests_violated"]
+             if leg else 0.0)
+        if leg is None or n < min_requests:
+            verdict["reasons"].append(
+                f"{name} leg has {int(n)} finished requests "
+                f"(< {min_requests}) — not enough signal")
+            return done("inconclusive")
+    verdict["baseline_stats"] = b
+    verdict["canary_stats"] = c
+    if golden and golden.get("mismatches", 0) > 0:
+        verdict["reasons"].append(
+            f"golden-token comparison: {golden['mismatches']}/"
+            f"{golden.get('samples', '?')} sampled requests diverged "
+            "from the baseline leg's tokens")
+        return done("rollback")
+    bf, cf = b.get("goodput_fraction"), c.get("goodput_fraction")
+    if bf is not None and cf is not None and cf < bf - margin:
+        verdict["reasons"].append(
+            f"canary goodput fraction {cf:.4f} more than {margin} "
+            f"below baseline {bf:.4f}")
+        return done("rollback")
+    verdict["reasons"].append(
+        "golden samples match"
+        if golden else "no golden samples taken")
+    if bf is not None and cf is not None:
+        verdict["reasons"].append(
+            f"goodput within margin ({cf:.4f} vs {bf:.4f})")
+    return done("promote")
+
+
+# -- fleet-stitched Perfetto export ------------------------------------------
+
+
+def stitch_perfetto(traces_by_replica: dict[str, dict]) -> list[dict]:
+    """Merge every replica's ``/debug/traces`` payload into one Chrome
+    trace-event list, keyed on trace id.
+
+    One Perfetto *process* row per replica (metadata ``process_name``
+    events carry the URL); each trace gets a stable *thread* row within
+    its replica so one request's spans line up as one lane. Spans are
+    deduplicated on ``(trace_id, span_id)`` across replicas: colocated
+    servers (tests, chip-sharing stacks, in-process benches) share a
+    single process tracer ring, and scraping N servers of one process
+    must not render every span N times."""
+    events: list[dict] = []
+    seen: set[tuple] = set()
+    for pid, url in enumerate(sorted(traces_by_replica), start=1):
+        payload = traces_by_replica[url] or {}
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": url}})
+        for trace in payload.get("traces", []):
+            tid = 1 + (hash(trace.get("trace_id", "")) & 0x7FFF)
+            for span in trace.get("spans", []):
+                key = (span.get("trace_id"), span.get("span_id"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                events.append({
+                    "ph": "X",
+                    "cat": "fleet",
+                    "name": span.get("name", "span"),
+                    "ts": float(span.get("start_s") or 0.0) * 1e6,
+                    "dur": float(span.get("duration_s") or 0.0) * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {
+                        "replica": url,
+                        "trace_id": span.get("trace_id"),
+                        "span_id": span.get("span_id"),
+                        "parent_id": span.get("parent_id"),
+                        **(span.get("attrs") or {}),
+                    },
+                })
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+    return events
+
+
+def write_perfetto(path: str, events: list[dict]) -> None:
+    """One merged trace file Perfetto / ``chrome://tracing`` open
+    directly (the ``traceEvents`` JSON object form)."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)

@@ -660,6 +660,21 @@ class OpenAIServer:
         reg = Registry()
         eng = self.engine
         s = eng.stats
+        # build identity (obs/buildinfo.py): the fleet collector keys
+        # its per-version scoreboard and canary verdict on these labels
+        from llm_in_practise_tpu.obs.buildinfo import register_build_info
+
+        register_build_info(reg, {
+            "server": "api",
+            "model": self.model_name,
+            "role": self.role,
+            "max_slots": eng.max_slots,
+            "cache_len": eng.cache_len,
+            "kv_layout": "paged" if eng.paged is not None else "dense",
+            "speculative_k": getattr(eng, "speculative_k", 0),
+            "decode_steps": getattr(eng, "decode_steps", 1),
+            "adapters": sorted(self.adapters),
+        })
         reg.counter_func("llm_requests_total",
                          lambda: s.requests_total,
                          "requests submitted to the engine")

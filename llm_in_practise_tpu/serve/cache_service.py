@@ -140,6 +140,15 @@ class CacheService:
         the migration subsumes; pinned by the exposition tests)."""
         c = self.cache
         reg = Registry()
+        # build identity (obs/buildinfo.py): same family on every server
+        from llm_in_practise_tpu.obs.buildinfo import register_build_info
+
+        register_build_info(reg, {
+            "server": "cache_service",
+            "ttl_s": c.ttl_s,
+            "max_entries": c.max_entries,
+            "semantic_threshold": c.semantic_threshold,
+        })
         reg.counter_func("llm_cache_exact_hits_total", lambda: c.hits)
         reg.counter_func("llm_cache_semantic_hits_total",
                          lambda: c.semantic_hits)

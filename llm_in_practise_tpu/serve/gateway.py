@@ -644,6 +644,9 @@ class Gateway:
         tenant_quotas: dict[str, float] | None = None,
         tenant_weights: dict[str, float] | None = None,
         tenant_quota_window_s: float = 60.0,
+        canary: dict[str, float] | None = None,
+        canary_golden_rate: float = 0.0,
+        fleet_fetch=None,
     ):
         self.router = router
         self.retry_policy = retry_policy
@@ -686,6 +689,35 @@ class Gateway:
         self.tenant_rejections: dict[str, int] = {}   # guarded-by: _tenant_lock
         # tenant -> {"ok": n, "violated": n} output tokens by SLO verdict
         self.tenant_goodput: dict[str, dict] = {}     # guarded-by: _tenant_lock
+        # weighted canary routing (ISSUE 18, ROADMAP 5(c)): ``canary``
+        # maps leg URL -> traffic fraction in [0, 1]. Canary legs live
+        # OUTSIDE the router (the stable pick never lands on one; a
+        # failed canary forward falls back to the stable path, so the
+        # canary can never lose a request). ``canary_golden_rate``
+        # shadow-samples deterministic (greedy / temperature==0)
+        # non-stream canary hits: the same body also goes to a stable
+        # upstream and the answers are compared token-for-token —
+        # the golden half of the promotion/rollback verdict.
+        self.canary_weights = {u.rstrip("/"): float(w)
+                               for u, w in (canary or {}).items()}
+        self.canary_golden_rate = float(canary_golden_rate)
+        self.canary_upstreams = [
+            Upstream(url, model="", group="canary", role="both",
+                     weight=w)
+            for url, w in sorted(self.canary_weights.items())]
+        # seeded so a bench/test drives a reproducible traffic split;
+        # draws happen under _stats_lock (Random isn't thread-safe)
+        import random
+
+        self._canary_rng = random.Random(0x18C0FFEE)  # guarded-by: _stats_lock
+        self._canary_requests: dict[tuple, int] = {}  # guarded-by: _stats_lock
+        self._canary_golden: dict[str, int] = {}      # guarded-by: _stats_lock
+        # GET /fleet: lazily built fleet collector over every upstream
+        # (stable + canary); ``fleet_fetch`` is the pluggable scrape
+        # transport (obs/fleet.py) — tests/benches go in-process
+        self._fleet_fetch = fleet_fetch
+        self._fleet_lock = threading.Lock()
+        self._fleet_collector = None                  # guarded-by: _fleet_lock
         self._disagg_model_warned: set = set()
         self._httpd: ThreadingHTTPServer | None = None
         self._health_thread: threading.Thread | None = None
@@ -747,7 +779,9 @@ class Gateway:
         autoscaler's drain check and least-pending routing both rely on
         this). ``trace``: the request's TraceContext, propagated as a
         traceparent header so the replica's spans join the trace."""
-        payload = dict(body, model=upstream.model)
+        # canary legs register with no model mapping — they serve
+        # whatever the request asked for (same group, newer build)
+        payload = dict(body, model=upstream.model or body.get("model"))
         headers = {"Content-Type": "application/json"}
         if trace is not None:
             headers["traceparent"] = format_traceparent(trace)
@@ -980,6 +1014,15 @@ class Gateway:
                 "type": "tenant_quota_exhausted",
             }}
 
+        # weighted canary split — after admission (canary traffic is
+        # still tenant-billed traffic) and OUTSIDE the retry chain: a
+        # canary leg that errors falls through to the stable path
+        # below, so the canary can never lose a request
+        if self.canary_upstreams:
+            got = self._canary_try(group, body, stream, span)
+            if got is not None:
+                return got
+
         # context-window fallback: if the estimate exceeds the group's
         # window, skip straight to the larger-context chain
         chain = self._chain(group)
@@ -1042,6 +1085,96 @@ class Gateway:
                     # a 4xx from one upstream will 4xx everywhere; stop
                     return last_status, last_detail
         return last_status, last_detail
+
+    # --- canary routing ------------------------------------------------------
+
+    def _canary_pick(self) -> Upstream | None:
+        """One uniform draw against the cumulative canary weights; None
+        = the stable path. The draw serializes on _stats_lock (a shared
+        ``random.Random`` is not thread-safe)."""
+        with self._stats_lock:
+            r = self._canary_rng.random()
+        acc = 0.0
+        for up in self.canary_upstreams:
+            acc += self.canary_weights.get(up.base_url, 0.0)
+            if r < acc:
+                return up
+        return None
+
+    def _canary_try(self, group: str, body: dict, stream: bool,
+                    span) -> tuple[int, object] | None:
+        """Forward one sampled request to a canary leg. None = not
+        sampled, or the leg failed — either way the caller runs the
+        stable path. Canary responses are never written to the response
+        cache: a regressed canary must not poison answers later served
+        to stable traffic."""
+        up = self._canary_pick()
+        if up is None:
+            return None
+        cs = self.tracer.start_span("gateway.canary", parent=span.context(),
+                                    upstream=up.base_url)
+        try:
+            status, resp = self._forward(up, body, stream=stream,
+                                         trace=cs.context())
+            ok = status == 200
+            cs.set(status=status, ok=ok)
+            with self._stats_lock:
+                key = (up.base_url, "ok" if ok else "error")
+                self._canary_requests[key] = (
+                    self._canary_requests.get(key, 0) + 1)
+            if not ok:
+                return None
+            if not stream and isinstance(resp, dict):
+                resp["model"] = group
+                self._canary_golden_shadow(group, body, resp, cs)
+            return status, resp
+        finally:
+            cs.end()
+
+    def _canary_golden_shadow(self, group: str, body: dict,
+                              canary_resp: dict, span) -> None:
+        """Golden-token comparison: re-run a sampled deterministic
+        (``temperature == 0``) canary hit against a stable upstream and
+        compare the answer texts. A mismatch is the hard half of the
+        canary verdict — identical builds must produce identical greedy
+        tokens, so ANY mismatch means the canary decodes differently.
+        Only explicit temperature-0 requests compare (sampled decoding
+        would mismatch by design); stable-side failures are simply not
+        a sample, never a verdict signal."""
+        if self.canary_golden_rate <= 0:
+            return
+        if body.get("temperature", 1) != 0:
+            return
+        with self._stats_lock:
+            sampled = self._canary_rng.random() < self.canary_golden_rate
+        if not sampled:
+            return
+        try:
+            upstream = self.router.pick_for_request(group, body)
+        except RouterError:
+            return
+        status, ref = self._forward(upstream, body, trace=span.context())
+        if status != 200 or not isinstance(ref, dict):
+            return
+
+        def _text(r):
+            try:
+                return r["choices"][0]["message"]["content"]
+            except (KeyError, IndexError, TypeError):
+                return None
+
+        result = ("match" if _text(canary_resp) == _text(ref)
+                  else "mismatch")
+        span.set(golden=result)
+        with self._stats_lock:
+            self._canary_golden[result] = (
+                self._canary_golden.get(result, 0) + 1)
+
+    def _canary_snapshot(self) -> tuple[dict, dict]:
+        """Canary counters read under their lock — the one helper the
+        scrape callbacks and fleet_payload go through."""
+        with self._stats_lock:
+            return dict(self._canary_requests), dict(self._canary_golden)
 
     def _counter_snapshot(self) -> dict:
         """Request-plane counters read under their lock — the one
@@ -1149,6 +1282,19 @@ class Gateway:
         tests pin); the label set/order is unchanged so existing
         dashboards keep matching."""
         reg = Registry()
+        # build identity (obs/buildinfo.py): the same family on every
+        # server in the stack — GET /fleet groups replicas by it
+        from llm_in_practise_tpu.obs.buildinfo import register_build_info
+
+        register_build_info(reg, {
+            "server": "gateway",
+            "router": type(self.router).__name__,
+            "groups": self.router.groups(),
+            "cache": type(self.cache).__name__ if self.cache else None,
+            "ttft_slo_s": self.goodput.ttft_slo_s,
+            "tpot_slo_s": self.goodput.tpot_slo_s,
+            "canary": sorted(self.canary_weights),
+        })
         reg.counter_func("gateway_requests_total",
                          lambda: self._counter_snapshot()["requests"],
                          "completions routed")
@@ -1268,10 +1414,80 @@ class Gateway:
                        per_tenant("balance"),
                        "current token-bucket balance per quota'd "
                        "tenant (negative = overdrawn, refilling)")
+
+        # canary plane (ISSUE 18): registered unconditionally — with no
+        # --canary legs both families render no samples, and the
+        # metric-docs census sees one stable set either way
+        reg.counter_func(
+            "gateway_canary_requests_total",
+            lambda: [({"url": url, "outcome": outcome}, v)
+                     for (url, outcome), v in
+                     sorted(self._canary_snapshot()[0].items())],
+            "requests sampled onto a canary leg by outcome (an 'error' "
+            "fell back to the stable path — the request was not lost)")
+        reg.counter_func(
+            "gateway_canary_golden_total",
+            lambda: [({"result": result}, v)
+                     for result, v in
+                     sorted(self._canary_snapshot()[1].items())],
+            "golden-token comparisons of deterministic canary answers "
+            "against a stable upstream (any mismatch => rollback)")
         return reg
 
     def metrics_text(self) -> str:
         return self.registry.render()
+
+    def fleet_payload(self) -> dict:
+        """``GET /fleet``: poll every upstream (stable pools + canary
+        legs) through the reset-safe collector (obs/fleet.py) and
+        return the fleet scoreboard plus a promotion/rollback verdict
+        per distinct canary version. The collector persists across
+        calls — that is what makes restarts visible (a reset is a
+        *decrease between polls*; a fresh collector would see the
+        post-restart counts as the first scrape and undercount)."""
+        from llm_in_practise_tpu.obs.fleet import FleetCollector
+
+        stable = sorted({u.base_url for u in self.router.upstreams})
+        with self._fleet_lock:
+            coll = self._fleet_collector
+            if coll is None:
+                coll = FleetCollector(
+                    [], fetch=self._fleet_fetch,
+                    timeout_s=min(self.timeout_s, 5.0))
+                self._fleet_collector = coll
+        # idempotent — picks up topology changes (autoscaler adds)
+        for url in stable + sorted(self.canary_weights):
+            coll.add_target(url)
+        coll.poll()
+        board = coll.scoreboard()
+        requests_by_leg, golden_counts = self._canary_snapshot()
+        by_url = {r["url"]: r for r in board["replicas"]}
+        # the baseline is the majority version among STABLE upstreams —
+        # a half-rolled fleet still compares against what most of the
+        # pool runs
+        stable_versions = [by_url[u]["version"] for u in stable
+                           if u in by_url]
+        baseline = (max(set(stable_versions), key=stable_versions.count)
+                    if stable_versions else "unknown")
+        golden = ({"samples": sum(golden_counts.values()),
+                   "mismatches": golden_counts.get("mismatch", 0)}
+                  if golden_counts else None)
+        verdicts: dict[str, dict] = {}
+        for url in sorted(self.canary_weights):
+            version = by_url.get(url, {}).get("version", "unknown")
+            if version not in verdicts:
+                verdicts[version] = coll.canary_verdict(
+                    baseline=baseline, canary=version, golden=golden)
+        board["canary"] = {
+            "weights": dict(self.canary_weights),
+            "golden": dict(golden_counts),
+            "requests": [{"url": url, "outcome": outcome, "count": n}
+                         for (url, outcome), n in
+                         sorted(requests_by_leg.items())],
+            "baseline_version": baseline,
+            "verdicts": verdicts,
+        }
+        return board
 
     def make_handler(self):
         gw = self
@@ -1281,6 +1497,8 @@ class Gateway:
                 if serve_obs_get(self, gw.metrics_text, gw.tracer):
                     return
                 try:
+                    if self.path == "/fleet":
+                        return self._json(200, gw.fleet_payload())
                     if self.path == "/v1/models":
                         return self._json(200, {
                             "object": "list",

@@ -452,6 +452,17 @@ class KVPoolServer:
     # -- metrics exposition ---------------------------------------------------
 
     def _build_registry(self, reg):
+        # build identity (obs/buildinfo.py): the fleet collector joins
+        # every server's series on these labels
+        from llm_in_practise_tpu.obs.buildinfo import register_build_info
+
+        register_build_info(reg, {
+            "server": "kv_pool",
+            "max_tokens": self.max_tokens,
+            "max_bytes": self.max_bytes,
+            "max_namespaces": self.max_namespaces,
+            "min_prefix": self.min_prefix,
+        })
         reg.counter_func("kvpool_hits_total", lambda: self.hits,
                          "prefix lookups served from the pool")
         reg.counter_func("kvpool_misses_total", lambda: self.misses,

@@ -88,7 +88,18 @@ class ModerationService:
 
     def metrics_text(self) -> str:
         if self._registry is None:
+            from llm_in_practise_tpu.obs.buildinfo import (
+                register_build_info,
+            )
+
             reg = Registry()
+            # build identity (obs/buildinfo.py): same family on every
+            # server in the stack
+            register_build_info(reg, {
+                "server": "moderation",
+                "model": self.model_name,
+                "api_key": bool(self.api_key),
+            })
             reg.counter_func("moderation_requests_total",
                              lambda: self.requests_total,
                              help="inputs scored by the classifier")

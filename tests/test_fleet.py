@@ -1,0 +1,517 @@
+"""Fleet observability plane (obs/fleet.py + obs/buildinfo.py + gateway
+canary routing) — ISSUE 18.
+
+The contract under test:
+
+- **reset-safe federation** — a replica restarting mid-window registers
+  as a counter reset + delta resync (the pre-reset total folds into the
+  resync base), never a negative fleet delta and never a silent
+  undercount; a replica that vanishes from the scrape set keeps its
+  frozen contribution and reports ``up=False``;
+- **promparse regression** — the strict exposition parser's
+  monotonicity check flags a decreased counter and a vanished family
+  (the artifact the fleet ledger exists to prevent);
+- **canary verdicts** — promotion/rollback from the per-version
+  rollup: golden-token mismatch → rollback, goodput fraction more than
+  the margin below baseline → rollback, thin legs → inconclusive,
+  otherwise promote;
+- **gateway canary routing** — weighted legs outside the router, a
+  failed canary falls back to the stable path (never loses a request),
+  deterministic hits golden-shadow against a stable upstream, and
+  ``GET /fleet`` scores it all;
+- **build identity** — ``llm_build_info`` on every server, env
+  overrides for rollout stamping, a config fingerprint that never
+  raises.
+"""
+
+import json
+
+import pytest
+
+from llm_in_practise_tpu.obs.buildinfo import (
+    build_info,
+    config_fingerprint,
+    register_build_info,
+)
+from llm_in_practise_tpu.obs.fleet import (
+    FleetCollector,
+    canary_verdict,
+    parse_exposition,
+    stitch_perfetto,
+    write_perfetto,
+)
+from llm_in_practise_tpu.obs.registry import Registry
+from tests.promparse import (
+    ExpositionError,
+    assert_counters_monotone,
+    parse_exposition as strict_parse,
+)
+
+
+# --- synthetic expositions ---------------------------------------------------
+
+
+def _expo(*, requests=0.0, ok=0.0, violated=0.0, version="v1",
+          sha="abc1234", extra=""):
+    return (
+        "# TYPE llm_build_info gauge\n"
+        f'llm_build_info{{version="{version}",git_sha="{sha}",'
+        'config_hash="cfg1"} 1\n'
+        "# TYPE llm_requests_total counter\n"
+        f"llm_requests_total {requests}\n"
+        "# TYPE llm_tokens_generated_total counter\n"
+        f"llm_tokens_generated_total {ok + violated}\n"
+        "# TYPE llm_goodput_tokens_total counter\n"
+        f'llm_goodput_tokens_total{{slo="ok"}} {ok}\n'
+        f'llm_goodput_tokens_total{{slo="violated"}} {violated}\n'
+        "# TYPE llm_slo_requests_total counter\n"
+        f'llm_slo_requests_total{{slo="ok"}} {requests}\n'
+        + extra)
+
+
+class _Fetch:
+    """Scriptable scrape transport: url -> exposition text, or an
+    exception instance to raise (a down replica)."""
+
+    def __init__(self, pages: dict):
+        self.pages = pages
+
+    def __call__(self, url, path):
+        if path != "/metrics":
+            raise LookupError(path)   # debug planes off in these tests
+        got = self.pages[url]
+        if isinstance(got, Exception):
+            raise got
+        return got
+
+
+def _total(coll, family="llm_requests_total"):
+    return sum(coll.fleet_counter(family).values())
+
+
+# --- promparse regression ----------------------------------------------------
+
+
+def test_promparse_flags_decreased_counter():
+    """The strict parser's monotonicity check rejects exactly the
+    artifact the fleet ledger is built to avoid emitting."""
+    before = strict_parse(
+        "# TYPE llm_requests_total counter\nllm_requests_total 10\n")
+    after = strict_parse(
+        "# TYPE llm_requests_total counter\nllm_requests_total 3\n")
+    with pytest.raises(ExpositionError, match="monoton|decreas"):
+        assert_counters_monotone(before, after)
+
+
+def test_promparse_flags_vanished_counter_family():
+    before = strict_parse(
+        "# TYPE llm_requests_total counter\nllm_requests_total 10\n"
+        "# TYPE llm_tokens_generated_total counter\n"
+        "llm_tokens_generated_total 5\n")
+    after = strict_parse(
+        "# TYPE llm_requests_total counter\nllm_requests_total 11\n")
+    with pytest.raises(ExpositionError):
+        assert_counters_monotone(before, after)
+
+
+# --- the tolerant fleet parser ----------------------------------------------
+
+
+def test_parse_exposition_tolerant():
+    text = (
+        "# HELP whatever ignored\n"
+        "# TYPE a_total counter\n"
+        "a_total 3\n"
+        'a_total{x="1"} broken-value\n'      # skipped, not fatal
+        "undeclared_metric 7\n"              # kept as untyped
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="1"} 2\n'
+        "lat_seconds_count 2\n"
+        "lat_seconds_sum 0.5\n"
+        '# TYPE esc gauge\nesc{m="a\\"b"} 1\n')
+    fams = parse_exposition(text)
+    assert fams["a_total"].kind == "counter"
+    assert fams["a_total"].samples[("a_total", ())] == 3.0
+    assert fams["undeclared_metric"].kind == "untyped"
+    # histogram samples resolve to the base family
+    keys = {k[0] for k in fams["lat_seconds"].samples}
+    assert keys == {"lat_seconds_bucket", "lat_seconds_count",
+                    "lat_seconds_sum"}
+    assert fams["esc"].samples[("esc", (("m", 'a"b'),))] == 1.0
+
+
+# --- reset-safe federation ---------------------------------------------------
+
+
+def test_collector_reset_resync():
+    """Restart mid-window: the pre-reset total folds into the base —
+    the fleet sum keeps counting forward, one reset is booked, and no
+    fleet total ever decreases."""
+    pages = {"r0": _expo(requests=10, ok=100)}
+    coll = FleetCollector(["r0"], fetch=_Fetch(pages), debug=False)
+    coll.poll()
+    assert _total(coll) == 10
+    pages["r0"] = _expo(requests=14, ok=120)
+    coll.poll()
+    assert _total(coll) == 14
+    # the restart: counters back near zero
+    pages["r0"] = _expo(requests=3, ok=20)
+    coll.poll()
+    assert _total(coll) == 14 + 3               # resynced, not negative
+    assert _total(coll, "llm_goodput_tokens_total") == 120 + 20
+    reps = coll.replicas()[0]
+    assert reps["resets"] == 1
+    assert reps["series_resyncs"] >= 2          # requests + ok series
+    assert coll.negative_deltas == 0
+    # and further growth counts on top of the resynced base
+    pages["r0"] = _expo(requests=5, ok=30)
+    coll.poll()
+    assert _total(coll) == 19
+    assert coll.replicas()[0]["resets"] == 1    # one restart, one reset
+
+
+def test_collector_replica_disappears():
+    """A dead replica is a data point: ``up=False``, its contribution
+    frozen at the last successful scrape — its work happened."""
+    pages = {"r0": _expo(requests=10), "r1": _expo(requests=7)}
+    coll = FleetCollector(["r0", "r1"], fetch=_Fetch(pages), debug=False)
+    coll.poll()
+    assert _total(coll) == 17
+    pages["r1"] = ConnectionError("gone")
+    status = coll.poll()
+    assert status["replicas"]["r1"]["up"] is False
+    assert _total(coll) == 17                   # frozen, not dropped
+    pages["r0"] = _expo(requests=12)
+    coll.poll()
+    assert _total(coll) == 19
+    r1 = {r["url"]: r for r in coll.replicas()}["r1"]
+    assert r1["scrape_failures"] == 2 and r1["up"] is False
+    assert coll.negative_deltas == 0
+
+
+def test_collector_down_then_restarted_replica_resyncs():
+    """Die → scrape fails → come back at zero: the comeback poll must
+    detect the reset against the PRE-death last values."""
+    pages = {"r0": _expo(requests=10)}
+    coll = FleetCollector(["r0"], fetch=_Fetch(pages), debug=False)
+    coll.poll()
+    pages["r0"] = OSError("connection refused")
+    coll.poll()
+    pages["r0"] = _expo(requests=2)             # fresh incarnation
+    coll.poll()
+    assert _total(coll) == 12
+    assert coll.replicas()[0]["resets"] == 1
+    assert coll.negative_deltas == 0
+
+
+def test_scoreboard_by_version_rollup():
+    pages = {
+        "r0": _expo(requests=6, ok=60, version="v1"),
+        "r1": _expo(requests=4, ok=40, version="v1"),
+        "r2": _expo(requests=5, ok=30, violated=30, version="v2"),
+    }
+    coll = FleetCollector(sorted(pages), fetch=_Fetch(pages), debug=False)
+    coll.poll()
+    board = coll.scoreboard()
+    assert board["up"] == 3
+    assert board["requests"] == 15
+    bv = board["by_version"]
+    assert sorted(bv) == ["v1", "v2"]
+    assert bv["v1"]["tokens_ok"] == 100 and bv["v1"]["goodput_fraction"] == 1.0
+    assert bv["v2"]["goodput_fraction"] == 0.5
+    assert set(bv["v1"]["replicas"]) == {"r0", "r1"}
+    assert board["slo"]["requests_ok"] == 15
+
+
+# --- canary verdicts ---------------------------------------------------------
+
+
+def _leg(ok=10.0, violated=0.0, tok_ok=100.0, tok_violated=0.0):
+    return {"replicas": ["u"], "requests_ok": ok,
+            "requests_violated": violated, "tokens_ok": tok_ok,
+            "tokens_violated": tok_violated, "tokens_generated": 0.0,
+            "resets": 0,
+            "attainment": ok / (ok + violated) if ok + violated else None,
+            "goodput_fraction": (tok_ok / (tok_ok + tok_violated)
+                                 if tok_ok + tok_violated else None)}
+
+
+def test_verdict_inconclusive_on_thin_legs():
+    got = canary_verdict({"v1": _leg(), "v2": _leg(ok=1)},
+                         baseline="v1", canary="v2", min_requests=5)
+    assert got["verdict"] == "inconclusive"
+    got = canary_verdict({"v1": _leg()}, baseline="v1", canary="missing")
+    assert got["verdict"] == "inconclusive"
+
+
+def test_verdict_golden_mismatch_rolls_back():
+    got = canary_verdict(
+        {"v1": _leg(), "v2": _leg()}, baseline="v1", canary="v2",
+        golden={"samples": 8, "mismatches": 1})
+    assert got["verdict"] == "rollback"
+    assert any("diverged" in r for r in got["reasons"])
+
+
+def test_verdict_goodput_margin_rolls_back():
+    got = canary_verdict(
+        {"v1": _leg(tok_ok=100, tok_violated=0),
+         "v2": _leg(tok_ok=80, tok_violated=20)},
+        baseline="v1", canary="v2", margin=0.05)
+    assert got["verdict"] == "rollback"
+    # inside the margin: promote
+    got = canary_verdict(
+        {"v1": _leg(tok_ok=100, tok_violated=0),
+         "v2": _leg(tok_ok=97, tok_violated=3)},
+        baseline="v1", canary="v2", margin=0.05)
+    assert got["verdict"] == "promote"
+
+
+def test_verdict_promotes_identical_legs():
+    got = canary_verdict(
+        {"v1": _leg(), "v2": _leg()}, baseline="v1", canary="v2",
+        golden={"samples": 4, "mismatches": 0})
+    assert got["verdict"] == "promote"
+    assert got["baseline_stats"]["requests_ok"] == 10
+
+
+# --- perfetto stitching ------------------------------------------------------
+
+
+def _span(tid, sid, name="api.chat", start=1.0, dur=0.5):
+    return {"name": name, "trace_id": tid, "span_id": sid,
+            "parent_id": None, "start_s": start, "duration_s": dur,
+            "attrs": {"k": "v"}}
+
+
+def test_stitch_perfetto_dedups_shared_ring(tmp_path):
+    """Colocated servers share one process tracer ring — the same span
+    scraped from two URLs must render once, under one replica row."""
+    shared = {"traces": [{"trace_id": "t1",
+                          "spans": [_span("t1", "s1"), _span("t1", "s2")]}]}
+    events = stitch_perfetto({"replica://0": shared, "replica://1": shared})
+    meta = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert len(meta) == 2                       # one process row per url
+    assert len(spans) == 2                      # deduplicated
+    assert {e["args"]["span_id"] for e in spans} == {"s1", "s2"}
+    assert spans[0]["ts"] == pytest.approx(1.0 * 1e6)
+    out = tmp_path / "fleet.json"
+    write_perfetto(str(out), events)
+    doc = json.loads(out.read_text())
+    assert len(doc["traceEvents"]) == 4
+    assert doc["displayTimeUnit"] == "ms"
+
+
+# --- build identity ----------------------------------------------------------
+
+
+def test_build_info_env_override(monkeypatch):
+    monkeypatch.setenv("LLM_TPU_BUILD_VERSION", "2.3.4-canary")
+    monkeypatch.setenv("LLM_TPU_BUILD_SHA", "deadbeef")
+    info = build_info({"a": 1})
+    assert info["version"] == "2.3.4-canary"
+    assert info["git_sha"] == "deadbeef"
+    assert info["config_hash"] == config_fingerprint({"a": 1})
+
+
+def test_config_fingerprint_stable_and_total():
+    assert (config_fingerprint({"a": 1, "b": 2})
+            == config_fingerprint({"b": 2, "a": 1}))
+    assert (config_fingerprint({"a": 1})
+            != config_fingerprint({"a": 2}))
+    # non-JSON values degrade to repr, never raise
+    assert config_fingerprint({"fn": parse_exposition})
+
+
+def test_register_build_info_renders_constant_gauge(monkeypatch):
+    monkeypatch.setenv("LLM_TPU_BUILD_VERSION", "9.9")
+    reg = Registry()
+    labels = register_build_info(reg, {"server": "test"})
+    assert labels["version"] == "9.9"
+    text = reg.render()
+    assert "# TYPE llm_build_info gauge" in text
+    assert 'version="9.9"' in text
+    fam = parse_exposition(text)["llm_build_info"]
+    assert list(fam.samples.values()) == [1.0]
+
+
+# --- gateway canary routing --------------------------------------------------
+
+
+def _mk_gateway(monkeypatch, *, weight=1.0, golden_rate=0.0,
+                canary_answer="same", stable_answer="same",
+                canary_status=200):
+    from llm_in_practise_tpu.serve.gateway import Gateway, Router, Upstream
+
+    gw = Gateway(Router([Upstream("http://stable:1", "m", group="chat")]),
+                 health_check_interval_s=0,
+                 canary={"http://canary:9": weight},
+                 canary_golden_rate=golden_rate)
+
+    def fake_forward(upstream, body, stream=False, trace=None):
+        if upstream.group == "canary":
+            if canary_status != 200:
+                return canary_status, {"error": {"message": "boom"}}
+            return 200, {"choices": [{"message": {
+                "content": canary_answer}}], "usage": {}}
+        return 200, {"choices": [{"message": {"content": stable_answer}}],
+                     "usage": {}}
+
+    monkeypatch.setattr(gw, "_forward", fake_forward)
+    return gw
+
+
+def test_canary_leg_serves_sampled_traffic(monkeypatch):
+    gw = _mk_gateway(monkeypatch, weight=1.0)
+    status, resp = gw.handle_completion(
+        {"model": "chat", "messages": [{"role": "user", "content": "hi"}]})
+    assert status == 200
+    assert resp["model"] == "chat"              # group, not the leg's ""
+    reqs, golden = gw._canary_snapshot()
+    assert reqs == {("http://canary:9", "ok"): 1}
+    assert golden == {}
+    text = gw.metrics_text()
+    assert ('gateway_canary_requests_total{url="http://canary:9",'
+            'outcome="ok"} 1') in text
+
+
+def test_canary_weight_zero_never_picks(monkeypatch):
+    gw = _mk_gateway(monkeypatch, weight=1e-12)
+    for _ in range(20):
+        status, _resp = gw.handle_completion(
+            {"model": "chat",
+             "messages": [{"role": "user", "content": "hi"}]})
+        assert status == 200
+    reqs, _ = gw._canary_snapshot()
+    assert not reqs
+
+
+def test_canary_failure_falls_back_to_stable(monkeypatch):
+    """The canary can never lose a request: a failed leg forward books
+    an error outcome and the stable path answers."""
+    gw = _mk_gateway(monkeypatch, weight=1.0, canary_status=503)
+    status, resp = gw.handle_completion(
+        {"model": "chat", "messages": [{"role": "user", "content": "hi"}]})
+    assert status == 200
+    assert resp["choices"][0]["message"]["content"] == "same"
+    reqs, _ = gw._canary_snapshot()
+    assert reqs == {("http://canary:9", "error"): 1}
+
+
+def test_canary_golden_shadow_counts_mismatch(monkeypatch):
+    gw = _mk_gateway(monkeypatch, weight=1.0, golden_rate=1.0,
+                     canary_answer="WRONG", stable_answer="right")
+    body = {"model": "chat", "temperature": 0,
+            "messages": [{"role": "user", "content": "hi"}]}
+    status, resp = gw.handle_completion(dict(body))
+    assert status == 200
+    _reqs, golden = gw._canary_snapshot()
+    assert golden == {"mismatch": 1}
+    # non-deterministic requests never compare
+    gw2 = _mk_gateway(monkeypatch, weight=1.0, golden_rate=1.0,
+                      canary_answer="WRONG", stable_answer="right")
+    gw2.handle_completion(
+        {"model": "chat", "messages": [{"role": "user", "content": "hi"}]})
+    assert gw2._canary_snapshot()[1] == {}
+
+
+def test_canary_golden_shadow_counts_match(monkeypatch):
+    gw = _mk_gateway(monkeypatch, weight=1.0, golden_rate=1.0)
+    body = {"model": "chat", "temperature": 0,
+            "messages": [{"role": "user", "content": "hi"}]}
+    gw.handle_completion(dict(body))
+    assert gw._canary_snapshot()[1] == {"match": 1}
+
+
+def test_gateway_fleet_payload_verdicts():
+    """GET /fleet end to end over an in-process scrape transport:
+    majority-version baseline, per-canary-version verdicts, golden
+    counts attached."""
+    from llm_in_practise_tpu.serve.gateway import Gateway, Router, Upstream
+
+    pages = {
+        "http://s0:1": _expo(requests=10, ok=100, version="v1"),
+        "http://s1:1": _expo(requests=10, ok=100, version="v1"),
+        "http://c0:1": _expo(requests=5, ok=50, version="v2"),
+    }
+    fetch = _Fetch(pages)
+    gw = Gateway(Router([Upstream("http://s0:1", "m", group="chat"),
+                         Upstream("http://s1:1", "m", group="chat")]),
+                 health_check_interval_s=0,
+                 canary={"http://c0:1": 0.25},
+                 fleet_fetch=lambda url, path: fetch(url, path))
+    board = gw.fleet_payload()
+    assert board["up"] == 3
+    canary = board["canary"]
+    assert canary["baseline_version"] == "v1"
+    assert canary["weights"] == {"http://c0:1": 0.25}
+    assert canary["verdicts"]["v2"]["verdict"] == "promote"
+    # now a golden mismatch arrives: the same poll flips to rollback
+    with gw._stats_lock:
+        gw._canary_golden["mismatch"] = 1
+        gw._canary_golden["match"] = 7
+    board = gw.fleet_payload()
+    v = board["canary"]["verdicts"]["v2"]
+    assert v["verdict"] == "rollback"
+    assert board["canary"]["golden"] == {"mismatch": 1, "match": 7}
+    # the collector persisted across calls: no spurious resets
+    assert board["counter_resets"] == 0
+
+
+def test_gateway_fleet_payload_detects_upstream_restart():
+    pages = {"http://s0:1": _expo(requests=10, version="v1")}
+    fetch = _Fetch(pages)
+    from llm_in_practise_tpu.serve.gateway import Gateway, Router, Upstream
+
+    gw = Gateway(Router([Upstream("http://s0:1", "m", group="chat")]),
+                 health_check_interval_s=0,
+                 fleet_fetch=lambda url, path: fetch(url, path))
+    gw.fleet_payload()
+    pages["http://s0:1"] = _expo(requests=2, version="v1")  # restarted
+    board = gw.fleet_payload()
+    assert board["counter_resets"] == 1
+    assert board["requests"] == 12
+    assert board["negative_deltas"] == 0
+
+
+# --- bench artifact + smoke --------------------------------------------------
+
+
+def test_bench_fleet_artifact_gates():
+    """The checked-in BENCH_FLEET artifact meets the acceptance
+    criteria: fleet totals reconcile with the per-incarnation truth
+    within 1% across the mid-replay restart (reset detected, zero
+    negative deltas), the regressed canary leg rolled back on golden
+    mismatches, and the identical leg promoted."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "BENCH_FLEET_r13.json")) as f:
+        artifact = json.load(f)
+    sb = artifact["scoreboard"]
+    assert sb["counter_resets"] >= 1
+    assert sb["negative_deltas"] == 0
+    assert artifact["down_window"]["replicas"]["replica://0"]["up"] is False
+    for fam, r in artifact["reconcile"].items():
+        assert r["rel_err"] <= artifact["reconcile_tol"], (fam, r)
+        assert r["dead_incarnation"] > 0        # the restart truly reset
+    assert artifact["verdicts"]["bad"]["verdict"] == "rollback"
+    assert artifact["golden"]["r13.2-regressed"]["mismatches"] >= 1
+    assert artifact["verdicts"]["good"]["verdict"] == "promote"
+    assert artifact["golden"]["r13.1"]["mismatches"] == 0
+    assert artifact["perfetto_events"] > 0
+
+
+def test_fleet_bench_smoke(tmp_path):
+    """End-to-end CPU smoke of the bench harness itself (tiny trace,
+    2 stable + 2 canary legs, mid-replay restart). Tier-1 on purpose —
+    this is the one test that drives real OpenAIServer registries
+    through the reset-safe collector across a restart. The gates
+    inside main() are the assertions."""
+    from tools.fleet_bench import main
+
+    artifact = main(quick=True, out=str(tmp_path / "fleet.json"))
+    assert artifact["quick"] is True
+    assert artifact["scoreboard"]["counter_resets"] >= 1
+    assert artifact["verdicts"]["bad"]["verdict"] == "rollback"
+    assert artifact["verdicts"]["good"]["verdict"] == "promote"

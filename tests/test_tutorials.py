@@ -11,10 +11,16 @@ import sys
 
 import pytest
 
+from tests import envcaps
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOCS = os.path.join(REPO, "docs", "tutorials")
 
 FILES = sorted(f for f in os.listdir(DOCS) if f.endswith(".md"))
+
+# tutorial blocks that exercise capability-gated APIs, keyed on the
+# same envcaps probes as the tests for those subsystems
+_NEEDS_CHECK_VMA = {"03_distributed_training.md"}
 
 
 def _python_blocks(path: str) -> str:
@@ -25,6 +31,9 @@ def _python_blocks(path: str) -> str:
 
 @pytest.mark.parametrize("fname", FILES)
 def test_tutorial_blocks_run(fname, tmp_path):
+    if (fname in _NEEDS_CHECK_VMA
+            and not envcaps.shard_map_has_check_vma()):
+        pytest.skip(envcaps.SHARD_MAP_CHECK_VMA_REASON)
     code = _python_blocks(os.path.join(DOCS, fname))
     if not code.strip():
         pytest.skip("no python blocks")

@@ -13,6 +13,13 @@ import pytest
 from llm_in_practise_tpu.core import mesh as mesh_lib
 from llm_in_practise_tpu.ops.attention import dense_attention
 from llm_in_practise_tpu.ops.ulysses import make_ulysses_attention
+from tests import envcaps
+
+# ulysses wraps shard_map with check_vma, same API class as ring
+# attention — skip precisely on the probed capability (tests/envcaps.py)
+pytestmark = pytest.mark.skipif(
+    not envcaps.shard_map_has_check_vma(),
+    reason=envcaps.SHARD_MAP_CHECK_VMA_REASON)
 
 
 def _qkv(rng, batch=2, seq=64, heads=8, head_dim=16, kv_heads=None):

@@ -20,7 +20,14 @@ from llm_in_practise_tpu.core import mesh as mesh_lib
 from llm_in_practise_tpu.parallel import strategy as S
 from llm_in_practise_tpu.train.step import make_train_step
 
+from tests import envcaps
 from tests.test_parallel import build_state, fake_batch
+
+# the moments live in pinned_host between steps; the CPU backend only
+# exposes unpinned_host — same probe as test_quant_opt's offload leg
+pytestmark = pytest.mark.skipif(
+    not envcaps.has_pinned_host_memory(),
+    reason=envcaps.pinned_host_reason())
 
 
 def _opt_leaves(state):

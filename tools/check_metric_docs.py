@@ -15,6 +15,14 @@ spans, ``{a,b,c}`` brace alternation
 (``llm_handoff_total{event=…}``), and ``*`` globs
 (``llm_prefix_cache_*``).
 
+The same census also lints the shipped Grafana dashboard
+(``deploy/k8s/monitoring/grafana-dashboard.json``): every metric
+family a panel expression references must exist in a default registry
+AND in the docs catalog — a renamed family otherwise leaves the
+dashboard silently flat (``[grafana]`` findings). Histogram
+``_bucket``/``_sum``/``_count`` sample suffixes resolve to their base
+family first.
+
 Run standalone: ``python tools/check_metric_docs.py``. Report lines and
 exit codes follow the repo's shared checker contract
 (``tools/graftlint/report.py``): rc 0 clean, rc 1 on drift, rc 2 on an
@@ -26,6 +34,7 @@ from __future__ import annotations
 
 import fnmatch
 import itertools
+import json
 import os
 import re
 import sys
@@ -34,9 +43,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DOC = os.path.join(REPO, "docs", "observability.md")
+GRAFANA = os.path.join(REPO, "deploy", "k8s", "monitoring",
+                       "grafana-dashboard.json")
 
 _CODE_SPAN = re.compile(r"`([^`]+)`")
 _NAME_TOKEN = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:{},*]*")
+# our families all carry one of the stack's prefixes; PromQL function
+# names / label names never match, so a bare word-boundary scan of the
+# expression string is enough
+_EXPR_METRIC = re.compile(
+    r"\b((?:llm|gateway|kvpool|moderation)_[a-zA-Z0-9_]+)")
+_HISTO_SUFFIXES = ("_bucket", "_count", "_sum")
 
 
 def doc_patterns(md_text: str) -> set[str]:
@@ -161,12 +178,71 @@ def check(registered=None, md_text: str | None = None) -> list[str]:
     return missing
 
 
+def grafana_metric_refs(dash: dict) -> list[tuple[str, str]]:
+    """``(panel title, family name)`` pairs for every metric family a
+    dashboard panel expression references (deduplicated, ordered)."""
+    out: list[tuple[str, str]] = []
+    seen: set[tuple[str, str]] = set()
+    for panel in dash.get("panels", []):
+        title = str(panel.get("title", f"panel {panel.get('id')}"))
+        for target in panel.get("targets", []):
+            for m in _EXPR_METRIC.finditer(str(target.get("expr", ""))):
+                pair = (title, m.group(1))
+                if pair not in seen:
+                    seen.add(pair)
+                    out.append(pair)
+    return out
+
+
+def check_grafana(registered=None, md_text: str | None = None,
+                  dash: dict | None = None) -> list[str]:
+    """Dashboard families that are unregistered or undocumented."""
+    if registered is None:
+        registered = collect_registered()
+    if md_text is None:
+        with open(DOC, encoding="utf-8") as f:
+            md_text = f.read()
+    if dash is None:
+        with open(GRAFANA, encoding="utf-8") as f:
+            dash = json.load(f)
+    patterns = doc_patterns(md_text)
+
+    def documented(name: str) -> bool:
+        return (name in patterns
+                or any("*" in p and fnmatch.fnmatch(name, p)
+                       for p in patterns))
+
+    findings = []
+    for title, name in grafana_metric_refs(dash):
+        # histogram panels reference rendered samples
+        # (…_seconds_bucket); registration and the catalog both speak
+        # in the base family
+        base = name
+        for suffix in _HISTO_SUFFIXES:
+            if name.endswith(suffix) and name[: -len(suffix)] in registered:
+                base = name[: -len(suffix)]
+                break
+        problems = []
+        if base not in registered:
+            problems.append("not registered by any default registry")
+        if not (documented(base) or documented(name)):
+            problems.append("missing from the docs catalog")
+        if problems:
+            findings.append(
+                f"panel {title!r} references {name}: "
+                + " AND ".join(problems))
+    return findings
+
+
 def main() -> int:
     from tools.graftlint import report
 
     doc_rel = os.path.relpath(DOC, REPO)
+    dash_rel = os.path.relpath(GRAFANA, REPO)
     try:
-        missing = check()
+        registered = collect_registered()
+        missing = check(registered=registered)
+        grafana = check_grafana(registered=registered)
     except Exception as e:  # noqa: BLE001 — a broken registry census is
         # an internal error (rc 2), not "zero drift"
         print(f"check_metric_docs: cannot build the registry census: "
@@ -175,9 +251,11 @@ def main() -> int:
     return report.emit(
         "check_metric_docs",
         [f"{doc_rel}: [metric-docs] {name}: registered metric family "
-         "missing from the docs catalog" for name in missing],
+         "missing from the docs catalog" for name in missing]
+        + [f"{dash_rel}: [grafana] {line}" for line in grafana],
         ok_summary=(f"every registered metric family is documented in "
-                    f"{doc_rel}"),
+                    f"{doc_rel}; every {dash_rel} panel expression "
+                    "resolves to a registered, documented family"),
         fail_hint="Add a catalog row (docs/observability.md) for each, "
                   "or fix the drifted name.")
 

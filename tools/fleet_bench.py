@@ -1,0 +1,371 @@
+"""Fleet observability bench — BENCH_FLEET artifact producer (CPU).
+
+Pins the fleet plane's two load-bearing claims (ISSUE 18) on a
+miniature fleet: two stable replicas plus two canary legs, each a real
+``OpenAIServer`` (its ``/metrics`` registry, the engine's
+``/debug/requests`` ring, the shared tracer's ``/debug/traces``),
+scraped in-process by the reset-safe :class:`FleetCollector`
+(obs/fleet.py) while a seeded multi-turn session trace
+(``serve/arrivals.synthesize_sessions``) replays through them.
+
+**Restart drill.** Mid-replay, stable replica 0 is KILLED and replaced
+by a fresh incarnation at the same URL — every counter restarts at
+zero. The collector must (a) report the down window as ``up=False``
+with the dead incarnation's contribution frozen, (b) register the
+comeback as a **counter reset + delta resync**, and (c) keep every
+fleet total monotone. The reconciliation gate closes the loop: fleet
+totals must match the per-incarnation ground truth (the dead
+incarnation's final scrape + the survivors' live counters) within 1%.
+
+**Canary verdicts, both directions.** The bad canary leg runs the SAME
+config with DIFFERENT weights (a fresh param seed) — its greedy tokens
+diverge from the stable pair's, so the golden-token comparison drives
+``rollback``. The good canary leg is bit-identical to the stable
+build under a new version label — golden matches, goodput within
+margin, so the verdict must be ``promote``. (The goodput-margin
+rollback direction is pinned deterministically with synthetic
+expositions in ``tests/test_fleet.py`` — CPU timing would make it
+flaky here.)
+
+Gates (asserted, and recorded in the artifact):
+
+- **reconciliation**: for ``llm_requests_total`` and
+  ``llm_tokens_generated_total``, |fleet − truth| ≤ 1% of truth across
+  the mid-replay restart;
+- **reset detected**: ≥1 counter reset on the restarted replica, and
+  the down window scraped as ``up=False`` with its contribution intact;
+- **no negative deltas**: the collector's fleet totals never went
+  backward (``negative_deltas == 0``);
+- **verdicts**: bad leg → ``rollback`` (with ≥1 golden mismatch),
+  identical leg → ``promote`` (with 0 mismatches in ≥1 samples).
+
+Run: ``JAX_PLATFORMS=cpu python tools/fleet_bench.py``
+Writes ``BENCH_FLEET_r13.json`` at the repo root; the tier-1 smoke
+runs ``main(quick=True)`` against a temp path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import zlib
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "BENCH_FLEET_r13.json")
+VOCAB = 128
+RECONCILE_TOL = 0.01
+BASELINE = "r13.0"
+CANARY_GOOD = "r13.1"          # identical weights, new version label
+CANARY_BAD = "r13.2-regressed"  # fresh param seed -> wrong greedy tokens
+CANARY_STRIDE = 3              # every 3rd arrival also probes a leg
+# generous SLOs so EVERY request books as goodput-ok on CPU — both
+# verdict legs then compare at fraction 1.0 and only the golden
+# comparison separates them (deterministic; no wall-clock gate)
+SLO_S = 60.0
+
+_FAMILIES = ("llm_requests_total", "llm_tokens_generated_total")
+
+
+class _Tok:
+    def encode(self, text):
+        return list(text.encode()[:32])
+
+    def decode(self, ids):
+        return bytes(int(i) % 256 for i in ids).decode("utf-8", "replace")
+
+
+def _build_engine(*, param_seed: int, cache_len: int):
+    import jax
+    import jax.numpy as jnp
+
+    from llm_in_practise_tpu.models.gpt import GPT, GPTConfig
+    from llm_in_practise_tpu.serve.engine import InferenceEngine
+
+    cfg = GPTConfig(vocab_size=VOCAB, seq_len=cache_len, n_layer=2,
+                    n_head=2, embed_dim=128, dropout=0.0,
+                    pos_embedding="rope")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(param_seed),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    return InferenceEngine(
+        model, params, max_slots=4, cache_len=cache_len,
+        cache_dtype=jnp.float32, kv_layout="paged",
+        ttft_slo_s=SLO_S, tpot_slo_s=SLO_S)
+
+
+class _Replica:
+    """One fleet member: engine + OpenAIServer surfaces behind an
+    in-process URL. ``respawn()`` is the restart drill — a brand-new
+    incarnation (all counters back at zero) at the same address."""
+
+    def __init__(self, idx: int, version: str, *, param_seed: int,
+                 cache_len: int):
+        self.base_url = f"replica://{idx}"
+        self.version = version
+        self.param_seed = param_seed
+        self.cache_len = cache_len
+        self.dead = False
+        self._spawn()
+
+    def _spawn(self):
+        from llm_in_practise_tpu.serve.api import OpenAIServer
+
+        self.engine = _build_engine(param_seed=self.param_seed,
+                                    cache_len=self.cache_len)
+        # build identity is resolved ONCE at registry build; the env
+        # override is how a rollout stamps the version (docs)
+        prev = os.environ.get("LLM_TPU_BUILD_VERSION")
+        os.environ["LLM_TPU_BUILD_VERSION"] = self.version
+        try:
+            self.server = OpenAIServer(self.engine, _Tok(),
+                                       model_name="chat")
+        finally:
+            if prev is None:
+                os.environ.pop("LLM_TPU_BUILD_VERSION", None)
+            else:
+                os.environ["LLM_TPU_BUILD_VERSION"] = prev
+        self.engine.start()
+
+    def kill(self):
+        self.engine.stop()
+        self.dead = True
+
+    def respawn(self):
+        self._spawn()
+        self.dead = False
+
+    def metrics_text(self) -> str:
+        return self.server.registry.render()
+
+    def counter(self, family: str) -> float:
+        from llm_in_practise_tpu.obs.fleet import parse_exposition
+
+        fam = parse_exposition(self.metrics_text()).get(family)
+        return sum(fam.samples.values()) if fam else 0.0
+
+
+def _make_fetch(fleet: dict[str, _Replica]):
+    """The in-process scrape transport: same three paths the HTTP
+    collector pulls, same down-replica failure mode."""
+
+    def fetch(url: str, path: str) -> str:
+        rep = fleet[url]
+        if rep.dead:
+            raise ConnectionError(f"{url} is down")
+        if path == "/metrics":
+            return rep.metrics_text()
+        if path == "/debug/requests":
+            return json.dumps(rep.engine.debug_requests())
+        if path == "/debug/traces":
+            return json.dumps(rep.server.tracer.debug_payload())
+        raise ValueError(path)
+
+    return fetch
+
+
+def _serve(rep: _Replica, prompt, max_tokens):
+    from llm_in_practise_tpu.serve.engine import SamplingParams
+
+    # a root span per request, like the HTTP path mints: the engine's
+    # phase spans only record for TRACED requests, and the stitched
+    # fleet Perfetto export reads that ring
+    span = rep.server.tracer.start_span("bench.request",
+                                        replica=rep.base_url)
+    try:
+        h = rep.engine.submit(
+            prompt, SamplingParams(greedy=True, max_tokens=max_tokens),
+            trace=span.context())
+        return h.result()
+    finally:
+        span.end()
+
+
+def main(*, quick: bool = False, out: str = OUT,
+         debug: bool = False) -> dict:
+    from llm_in_practise_tpu.obs.fleet import (
+        FleetCollector, canary_verdict, stitch_perfetto,
+    )
+    from llm_in_practise_tpu.serve.arrivals import (
+        describe_sessions, synthesize_sessions,
+    )
+
+    cache_len = 512
+    schedule = synthesize_sessions(
+        seed=42, n_sessions=3 if quick else 8,
+        turns=(2, 3) if quick else (2, 4),
+        mean_iat_s=0.0,
+        prompt_tokens=(24, 48),
+        max_tokens=(4, 8))
+    stable = [_Replica(i, BASELINE, param_seed=0, cache_len=cache_len)
+              for i in range(2)]
+    good = _Replica(2, CANARY_GOOD, param_seed=0, cache_len=cache_len)
+    bad = _Replica(3, CANARY_BAD, param_seed=1, cache_len=cache_len)
+    fleet = {r.base_url: r for r in [*stable, good, bad]}
+    coll = FleetCollector(sorted(fleet), fetch=_make_fetch(fleet))
+
+    rng = np.random.default_rng(7)
+    history: dict[str, list[int]] = {}
+    golden = {CANARY_GOOD: {"samples": 0, "mismatches": 0},
+              CANARY_BAD: {"samples": 0, "mismatches": 0}}
+    canary_legs = [good, bad]
+    victim = stable[0]
+    kill_at = max(2, int(len(schedule) * 0.6))
+    poll_every = max(1, len(schedule) // 6)
+    frozen_during_down: dict[str, float] | None = None
+    down_status = None
+    dead_final: dict[str, float] = {}
+    t_bench = time.monotonic()
+
+    for i, a in enumerate(schedule):
+        if i == kill_at:
+            # --- restart drill ---------------------------------------
+            # poll-before-drain: counts made after the last successful
+            # scrape die with the incarnation (the documented limit) —
+            # a real rollout drains connections first, the bench
+            # scrapes first, same discipline
+            coll.poll()
+            dead_final = {f: victim.counter(f) for f in _FAMILIES}
+            pre_kill = {f: sum(coll.fleet_counter(f).values())
+                        for f in _FAMILIES}
+            victim.kill()
+            # the down window: scrape must fail, contribution must
+            # freeze at the dead incarnation's totals
+            down_status = coll.poll()
+            frozen_during_down = {
+                f: sum(coll.fleet_counter(f).values())
+                for f in _FAMILIES}
+            assert frozen_during_down == pre_kill, (
+                "a dead replica's contribution moved: "
+                f"{frozen_during_down} != {pre_kill}")
+            victim.respawn()
+        elif i % poll_every == 0:
+            coll.poll()
+        sid = a.session_id
+        prompt = history.get(sid, []) + [
+            int(t) for t in rng.integers(1, VOCAB, size=a.prompt_tokens)]
+        # zlib, not hash(): str hash is salted per process and would
+        # unbalance the stable split across runs
+        rep = stable[zlib.crc32(sid.encode()) % 2]
+        outs = _serve(rep, prompt, a.max_tokens)
+        history[sid] = prompt + outs
+        if debug:
+            print(f"turn {i}: {sid} -> {rep.base_url} "
+                  f"({len(outs)} tokens)")
+        # canary sampling + golden pairing: every CANARY_STRIDE-th
+        # arrival also runs on a leg (alternating legs — deterministic,
+        # so the quick schedule still samples BOTH); the leg serves the
+        # SAME prompt and its greedy tokens must match the stable answer
+        if i % CANARY_STRIDE == CANARY_STRIDE - 1:
+            leg = canary_legs[(i // CANARY_STRIDE) % 2]
+            leg_outs = _serve(leg, prompt, a.max_tokens)
+            golden[leg.version]["samples"] += 1
+            if leg_outs != outs:
+                golden[leg.version]["mismatches"] += 1
+    coll.poll()
+    wall = time.monotonic() - t_bench
+
+    # --- reconciliation: fleet totals vs per-incarnation truth -------------
+    reconcile = {}
+    for fam in _FAMILIES:
+        truth = dead_final.get(fam, 0.0) + sum(
+            rep.counter(fam) for rep in fleet.values())
+        total = sum(coll.fleet_counter(fam).values())
+        reconcile[fam] = {
+            "fleet_total": total,
+            "truth": truth,
+            "dead_incarnation": dead_final.get(fam, 0.0),
+            "rel_err": (abs(total - truth) / truth) if truth else 0.0,
+        }
+
+    board = coll.scoreboard()
+    verdicts = {
+        "bad": canary_verdict(board["by_version"], baseline=BASELINE,
+                              canary=CANARY_BAD,
+                              golden=golden[CANARY_BAD]),
+        "good": canary_verdict(board["by_version"], baseline=BASELINE,
+                               canary=CANARY_GOOD,
+                               golden=golden[CANARY_GOOD]),
+    }
+    perfetto_events = stitch_perfetto(coll.traces_by_replica())
+    by_victim = {r["url"]: r for r in board["replicas"]}[victim.base_url]
+
+    artifact = {
+        "bench": "fleet",
+        "round": "r13",
+        "issue": 18,
+        "backend": "cpu",
+        "quick": quick,
+        "arrivals": describe_sessions(schedule),
+        "wall_s": round(wall, 3),
+        "replicas": board["replicas"],
+        "scoreboard": {k: board[k] for k in
+                       ("up", "counter_resets", "negative_deltas",
+                        "slo", "blame", "tokens_generated", "requests")},
+        "by_version": board["by_version"],
+        "down_window": down_status,
+        "reconcile": reconcile,
+        "reconcile_tol": RECONCILE_TOL,
+        "golden": golden,
+        "verdicts": {k: {kk: v[kk] for kk in
+                         ("baseline", "canary", "verdict", "reasons")}
+                     for k, v in verdicts.items()},
+        "perfetto_events": len(perfetto_events),
+    }
+    for rep in fleet.values():
+        rep.engine.stop()
+
+    # --- gates (the acceptance criteria, verbatim) --------------------------
+    assert down_status["replicas"][victim.base_url]["up"] is False, (
+        "the kill window never scraped as down")
+    assert by_victim["resets"] >= 1, (
+        "the restart was not detected as a counter reset")
+    assert board["negative_deltas"] == 0, (
+        f"{board['negative_deltas']} fleet totals went backward")
+    for fam, r in reconcile.items():
+        assert r["rel_err"] <= RECONCILE_TOL, (
+            f"{fam}: fleet total {r['fleet_total']:.0f} vs truth "
+            f"{r['truth']:.0f} — off by {r['rel_err']:.2%} "
+            f"(> {RECONCILE_TOL:.0%}) across the restart")
+    assert golden[CANARY_BAD]["mismatches"] >= 1, (
+        "the regressed leg's greedy tokens never diverged — the "
+        "injected regression is not observable")
+    assert verdicts["bad"]["verdict"] == "rollback", (
+        f"regressed leg got {verdicts['bad']['verdict']!r}, "
+        "want rollback")
+    assert golden[CANARY_GOOD]["samples"] >= 1, (
+        "the identical leg was never golden-sampled")
+    assert golden[CANARY_GOOD]["mismatches"] == 0, (
+        "the identical leg diverged from the stable build")
+    assert verdicts["good"]["verdict"] == "promote", (
+        f"identical leg got {verdicts['good']['verdict']!r}, "
+        "want promote")
+    assert len(perfetto_events) > len(fleet), (
+        "the stitched fleet trace is empty")
+
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({k: artifact[k] for k in
+                      ("scoreboard", "reconcile", "golden",
+                       "verdicts")}, indent=1))
+    print(f"wrote {out}")
+    return artifact
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(
+        description="fleet federation bench -> BENCH_FLEET_r13.json")
+    p.add_argument("--quick", action="store_true",
+                   help="small schedule smoke (same gates)")
+    p.add_argument("--debug", action="store_true")
+    p.add_argument("--out", default=OUT, metavar="PATH",
+                   help="artifact path (default: the repo artifact — "
+                        "point elsewhere for smoke runs)")
+    a = p.parse_args()
+    main(quick=a.quick, out=a.out, debug=a.debug)

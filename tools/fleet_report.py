@@ -1,0 +1,153 @@
+"""One-shot fleet report: scrape targets, print the scoreboard.
+
+The CLI face of the fleet collector (obs/fleet.py) for when there is no
+gateway to ask (``GET /fleet``) — point it at every replica's base URL
+and it prints the replica table (build identity, up/down, detected
+restarts), the SLO scoreboard with per-phase blame, the per-version
+rollup, and — with ``--baseline``/``--canary`` — the promotion verdict.
+
+Two polls separated by ``--interval`` make restarts *visible* (a reset
+is a decrease between polls; a single scrape has nothing to compare),
+and give rates a denominator. ``--perfetto PATH`` additionally stitches
+every replica's ``/debug/traces`` ring into one Chrome-JSON trace file
+(one Perfetto process row per replica — docs/observability.md).
+
+    python -m tools.fleet_report \
+        --target http://replica-0:8000 --target http://replica-1:8000 \
+        --interval 5 --perfetto /tmp/fleet.json
+
+Exit code: 0 when every target scraped at least once, 1 otherwise
+(a report over zero replicas is not a report).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from llm_in_practise_tpu.obs.fleet import FleetCollector, stitch_perfetto, write_perfetto
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4f}" if abs(v) < 1000 else f"{v:.1f}"
+    return str(v)
+
+
+def render(board: dict, *, verdict: dict | None = None) -> str:
+    """The scoreboard as a terminal table (also what the smoke test
+    pins, so keep the section headers stable)."""
+    out = []
+    out.append("== replicas ==")
+    out.append(f"{'url':<40} {'up':<5} {'version':<16} "
+               f"{'git_sha':<12} {'resets':<7} fails")
+    for r in board["replicas"]:
+        out.append(f"{r['url']:<40} {str(r['up']):<5} "
+                   f"{r['version']:<16} {r['git_sha'][:12]:<12} "
+                   f"{r['resets']:<7} {r['scrape_failures']}")
+    slo = board["slo"]
+    out.append("")
+    out.append("== scoreboard ==")
+    out.append(f"replicas up            {board['up']}/{len(board['replicas'])}")
+    out.append(f"requests (engine)      {board['requests']:.0f}")
+    out.append(f"tokens generated       {board['tokens_generated']:.0f}")
+    out.append(f"counter resets         {board['counter_resets']}")
+    out.append(f"negative fleet deltas  {board['negative_deltas']}")
+    out.append(f"SLO attainment         {_fmt(slo['attainment'])} "
+               f"({slo['requests_ok']:.0f} ok / "
+               f"{slo['requests_violated']:.0f} violated)")
+    out.append(f"goodput fraction       {_fmt(slo['goodput_fraction'])} "
+               f"({slo['tokens_ok']:.0f} ok / "
+               f"{slo['tokens_violated']:.0f} violated tokens)")
+    if board.get("blame"):
+        out.append("blame by phase         " + ", ".join(
+            f"{k}={v:.0f}" for k, v in sorted(board["blame"].items())))
+    if board.get("critical_path_seconds"):
+        out.append("critical path (s)      " + ", ".join(
+            f"{k}={v:.3f}" for k, v in
+            sorted(board["critical_path_seconds"].items())))
+    if board.get("session_turns"):
+        out.append("session turns          " + ", ".join(
+            f"{k}={v:.0f}" for k, v in
+            sorted(board["session_turns"].items())))
+    if board.get("tenants"):
+        out.append("")
+        out.append("== tenants ==")
+        for tenant, d in sorted(board["tenants"].items()):
+            out.append(f"  {tenant:<24} " + ", ".join(
+                f"{k}={v:.0f}" for k, v in sorted(d.items())))
+    out.append("")
+    out.append("== by version ==")
+    for version, v in sorted(board["by_version"].items()):
+        out.append(f"  {version:<16} replicas={len(v['replicas'])} "
+                   f"attainment={_fmt(v['attainment'])} "
+                   f"goodput={_fmt(v['goodput_fraction'])} "
+                   f"tokens={v['tokens_generated']:.0f} "
+                   f"resets={v['resets']}")
+    if verdict is not None:
+        out.append("")
+        out.append("== canary verdict ==")
+        out.append(f"  {verdict['canary']} vs {verdict['baseline']}: "
+                   f"{verdict['verdict'].upper()}")
+        for reason in verdict["reasons"]:
+            out.append(f"  - {reason}")
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fleet_report",
+        description="scrape replica /metrics + /debug planes and print "
+                    "the fleet scoreboard")
+    p.add_argument("--target", action="append", default=[],
+                   metavar="URL", required=True,
+                   help="repeatable: replica base URL to scrape")
+    p.add_argument("--interval", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="poll twice, SECONDS apart (restarts and rates "
+                        "need two samples); 0 = single poll")
+    p.add_argument("--baseline", default=None, metavar="VERSION",
+                   help="with --canary: score VERSION as the stable leg")
+    p.add_argument("--canary", default=None, metavar="VERSION",
+                   help="with --baseline: emit the promote/rollback "
+                        "verdict for VERSION")
+    p.add_argument("--margin", type=float, default=0.05,
+                   help="goodput-fraction rollback margin (absolute)")
+    p.add_argument("--perfetto", default=None, metavar="PATH",
+                   help="write the fleet-stitched Chrome trace here")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw scoreboard JSON instead of the "
+                        "table")
+    args = p.parse_args(argv)
+
+    coll = FleetCollector(args.target)
+    coll.poll()
+    if args.interval > 0:
+        time.sleep(args.interval)
+        coll.poll()
+    board = coll.scoreboard()
+    verdict = None
+    if args.baseline and args.canary:
+        verdict = coll.canary_verdict(baseline=args.baseline,
+                                      canary=args.canary,
+                                      margin=args.margin)
+        board["canary_verdict"] = verdict
+    if args.json:
+        print(json.dumps(board, indent=1, sort_keys=True))
+    else:
+        print(render(board, verdict=verdict))
+    if args.perfetto:
+        events = stitch_perfetto(coll.traces_by_replica())
+        write_perfetto(args.perfetto, events)
+        print(f"\nperfetto: {len(events)} events -> {args.perfetto}",
+              file=sys.stderr)
+    scraped = sum(1 for r in board["replicas"] if r["polls"] > 0)
+    return 0 if scraped == len(board["replicas"]) and scraped else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
