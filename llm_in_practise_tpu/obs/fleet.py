@@ -392,7 +392,40 @@ class FleetCollector:
         recent = self._recent_requests()
         if recent is not None:
             board["recent"] = recent
+        hbm = self._hbm_ownership()
+        if hbm:
+            board["hbm"] = hbm
         return board
+
+    def _hbm_ownership(self) -> dict:
+        """Per-replica HBM attribution rollup (ISSUE 19): each
+        replica's ``llm_hbm_ledger_bytes{owner=…}`` gauges plus its
+        reconciliation residual, and the fleet-wide per-owner sum.
+        Gauges are last-seen levels, not counters — no reset math."""
+        per_replica: dict[str, dict] = {}
+        owners_total: dict[str, float] = {}
+        with self._lock:
+            for led in self._replicas.values():
+                owners: dict[str, float] = {}
+                unattributed = None
+                for (sname, labels), value in led.gauges.items():
+                    if sname == "llm_hbm_ledger_bytes":
+                        owner = dict(labels).get("owner")
+                        if owner:
+                            owners[owner] = value
+                    elif sname == "llm_hbm_unattributed_bytes":
+                        unattributed = value
+                if not owners and unattributed is None:
+                    continue
+                per_replica[led.url] = {
+                    "owners": owners,
+                    "unattributed_bytes": unattributed,
+                }
+                for owner, v in owners.items():
+                    owners_total[owner] = owners_total.get(owner, 0.0) + v
+        if not per_replica:
+            return {}
+        return {"replicas": per_replica, "owners": owners_total}
 
     def _by_version(self) -> dict[str, dict]:
         """Per-build-version rollup — the canary verdict's input. Each

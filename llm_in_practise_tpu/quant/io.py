@@ -139,7 +139,7 @@ def save_packed(out_dir: str, qtree, *, metadata: dict | None = None) -> str:
 _MAX_MANIFEST_FORMAT = 2
 
 
-def load_packed(out_dir: str):
+def load_packed(out_dir: str, *, ledger_account: str | None = None):
     """Read a packed tree back: ``(qtree, metadata)``.
 
     Raises on manifest ``format`` versions newer than this reader
@@ -147,6 +147,13 @@ def load_packed(out_dir: str):
     itself moved bf16 tagging from per-leaf to per-array), and loading
     one with old rules would silently rebuild garbage uint16 weights
     instead of failing loudly.
+
+    ``ledger_account`` (e.g. ``"weights/quantized"``) books the loaded
+    tree's bytes into the HBM ledger (obs/hbm.py) under that owner.
+    Leave it None when the caller books the tree itself — an engine
+    built over this tree registers ``weights/model`` from the SAME
+    bytes, and a double booking would show up as a negative
+    reconciliation residual.
     """
     with open(os.path.join(out_dir, "manifest.json")) as f:
         manifest = json.load(f)
@@ -166,4 +173,9 @@ def load_packed(out_dir: str):
         for part in parts[:-1]:
             node = node.setdefault(part, {})
         node[parts[-1]] = _rebuild_leaf(entry, key, arrays, bf16_names)
+    if ledger_account is not None:
+        from llm_in_practise_tpu.obs.cost import tree_bytes
+        from llm_in_practise_tpu.obs.hbm import get_ledger
+
+        get_ledger().book(ledger_account, tree_bytes(tree))
     return tree, manifest["metadata"]

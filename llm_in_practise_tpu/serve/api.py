@@ -42,6 +42,11 @@ from http.server import ThreadingHTTPServer
 import numpy as np
 
 from llm_in_practise_tpu.data.sft import IM_START, render_chatml
+from llm_in_practise_tpu.obs.hbm import (
+    get_ledger,
+    host_entry_bytes,
+    register_hbm_ledger,
+)
 from llm_in_practise_tpu.obs.registry import Registry
 from llm_in_practise_tpu.obs.trace import get_tracer, parse_traceparent
 from llm_in_practise_tpu.serve import constrain, schemas
@@ -408,6 +413,12 @@ class OpenAIServer:
                         kv_entry = store.claim(str(xfer["handoff_id"]))
                     cs.set(found=kv_entry is not None)
                 self.handoff_meter.claim_outcome(kv_entry is not None)
+                if kv_entry is not None:
+                    # claim-side staging: the host entry lives only
+                    # until admission scatters it — shorter than any
+                    # scrape, so pulse (peak), don't book (level)
+                    get_ledger().pulse("handoff_staging",
+                                       host_entry_bytes(kv_entry))
             # session fleet miss path (serve/sessions.py): an unknown
             # session on this replica (ring rebalance / replica death
             # remapped it here) pulls its KV from the pool's handoff
@@ -428,6 +439,8 @@ class OpenAIServer:
                         ps.set(found=pulled is not None)
                     if pulled is not None:
                         sess_store.adopt(session_id, pulled)
+                        get_ledger().pulse("handoff_staging",
+                                           host_entry_bytes(pulled))
                     else:
                         sess_store.note_lost()
             handle = engine.submit(prompt_ids, params, kv_entry=kv_entry,
@@ -756,6 +769,10 @@ class OpenAIServer:
         reg.gauge_func("llm_device_hbm_bytes", _hbm,
                        "device memory from device.memory_stats(): "
                        "bytes in use / peak / limit")
+        # HBM ownership ledger (obs/hbm.py, ISSUE 19): per-owner
+        # attribution of the bytes the aggregate family above only
+        # totals, plus the reconciliation residual between the two
+        register_hbm_ledger(reg)
         # tensor-parallel plane (docs/serving-tp.md): the mesh extent
         # and the analytic per-chip collective attribution — wire bytes
         # of the row-parallel activation all-reduces and the
@@ -1119,6 +1136,12 @@ class OpenAIServer:
                         # accounting (serve/sessions.py, ISSUE 17)
                         return self._json(
                             200, server.engine.debug_sessions())
+                    if self.path == "/debug/hbm":
+                        # HBM ownership tree + per-account high-water
+                        # marks + reconciliation residual (obs/hbm.py,
+                        # docs/observability.md "Memory plane")
+                        return self._json(
+                            200, get_ledger().debug_tree())
                     if self.path == "/v1/models":
                         return self._json(200, {
                             "object": "list",

@@ -45,7 +45,8 @@ import numpy as np
 
 from llm_in_practise_tpu.infer.generate import max_positions
 from llm_in_practise_tpu.infer.sampling import sample_token_batched
-from llm_in_practise_tpu.obs.cost import CostModel
+from llm_in_practise_tpu.obs.cost import CostModel, tree_bytes
+from llm_in_practise_tpu.obs.hbm import get_ledger, host_entry_bytes
 from llm_in_practise_tpu.obs.logging import get_logger
 from llm_in_practise_tpu.obs.meter import DispatchMeter, GoodputMeter
 from llm_in_practise_tpu.obs.prof import CompileMeter
@@ -841,6 +842,25 @@ class InferenceEngine:
 
         self.tp_quantized_collectives = isinstance(
             getattr(model, "inner", model), TPQuantizedCollectives)
+
+        # HBM ledger (obs/hbm.py, ISSUE 19): book this engine's durable
+        # device allocations under their owner accounts; stop() returns
+        # every byte. The page pool booked itself inside PagedKV; the
+        # per-dispatch transient gather views pulse at the dispatch
+        # sites. kv.draft is the draft cache's REAL byte footprint —
+        # the same quantity /debug/kv's draft_kv_reserved_tokens
+        # expresses in pool tokens through the kv_row_bytes exchange
+        # rate, so --speculative setups see the draft tax on the
+        # ownership scoreboard.
+        self._hbm = get_ledger()
+        self._hbm_booked = {}  # engine thread + stop(); freed once
+        self._hbm_book("weights/model", tree_bytes(self.params))
+        if self.cache is not None:
+            self._hbm_book("kv.contiguous", tree_bytes(self.cache))
+        if self.draft_model is not None:
+            self._hbm_book("weights/draft_model",
+                           tree_bytes(self.draft_params))
+            self._hbm_book("kv.draft", tree_bytes(self.draft_cache))
 
         # Dispatch accounting: every jitted engine program is wrapped so
         # /metrics (llm_dispatches_*) and the mixed-step tests can assert
@@ -1703,6 +1723,7 @@ class InferenceEngine:
             # for this request (its target KV is being recomputed)
             self._draft_uid[slot] = -1
         self.preemptions += 1
+        self._hbm.note_reclaim("kv_pool.pages", "preempt")
         # the re-admission's wait + recompute are charged to the
         # preempt_recompute critical-path segment from this stamp on;
         # the queue-wait origin moves here too (the slotted time just
@@ -1740,6 +1761,14 @@ class InferenceEngine:
         return [s for s in out if self.slot_req[s] is not None
                 and self.slot_ready[s]]
 
+    def _pulse_view(self, W: int, n_slots: int | None = None) -> None:
+        """Ledger-pulse this dispatch's transient gather view (account
+        ``transient_view``): W tokens × the viewed rows at the pool's
+        byte rate. XLA frees the view inside the dispatch, so only the
+        account's high-water mark moves — the pool+view coexistence
+        peak ROADMAP item 1's in-place paged attention reclaims."""
+        self._hbm.pulse("transient_view", self.paged.view_bytes(W, n_slots))
+
     def _paged_decode_dispatch(self, active: list[int], n: int, sub,
                                gmask=None, lora=None):
         """Issue one paged decode dispatch (single-token via the
@@ -1752,6 +1781,7 @@ class InferenceEngine:
         Returns the sampled tokens, shape (max_slots, n)."""
         W = self._paged_width(
             max(int(self.slot_len[s]) for s in active) + n)
+        self._pulse_view(W)
         idxv = self._paged_index_vec(W, n)
         valid = np.zeros((self.max_slots,), np.int32)
         for s in active:
@@ -2455,10 +2485,17 @@ class InferenceEngine:
         while True:
             req, plen, entry = self._publish_queue.get()
             t0 = time.monotonic()
+            staged = 0
             try:
                 if self.handoff is None:
                     raise RuntimeError("engine has no handoff store")
-                self.handoff.publish(req.handoff_id, entry_to_host(entry))
+                host = entry_to_host(entry)
+                # ledger account handoff_staging (host plane): the
+                # entry's RAM between the device→host copy and the
+                # pool put — freed below whether the put lands or not
+                staged = host_entry_bytes(host)
+                self._hbm.book("handoff_staging", staged)
+                self.handoff.publish(req.handoff_id, host)
             except Exception as e:  # noqa: BLE001 — transport/pool
                 # refusal: the request must still finish (the caller
                 # re-prefills at a serving replica)
@@ -2471,6 +2508,8 @@ class InferenceEngine:
                 with self._publish_lock:
                     self.handoff_published += 1
                 req.finish_reason = "handoff"
+            if staged:
+                self._hbm.book("handoff_staging", -staged)
             # device→host copy + store put — the KV-transfer cost the
             # disaggregation trade pays; its span is how a dashboard
             # shows handoff overhead per request
@@ -2835,6 +2874,9 @@ class InferenceEngine:
         one = lora is None
         with self.steptrace.scope("index_build"):
             W = self._paged_width(done + C)
+            # the single-row path gathers ONE slot's pages, not a
+            # W-wide view of every slot — pulse what it actually costs
+            self._pulse_view(W, 1 if one else None)
             if one:
                 tok = np.zeros((1, C), np.int32)
                 tok[0, :len(suffix)] = suffix
@@ -3076,6 +3118,7 @@ class InferenceEngine:
         tok, starts, lens = self._chunk_batch_rows(entries)
         W = self._paged_width(
             max(st["done"] for _, st, _ in entries) + C)
+        self._pulse_view(W)
         # non-prefill rows' dead C-wide in-view writes must stay inside
         # the view; their view copy is discarded (windows are trash),
         # so the clamp is harmless — prefill rows stay exact
@@ -3443,6 +3486,7 @@ class InferenceEngine:
                 W = self._paged_width(
                     max(int(self.slot_len[s]) for s in active)
                     + k + 1 + m)
+                self._pulse_view(W)
                 idxv = self._paged_index_vec(W, k + 1 + m)
                 valid = np.zeros((self.max_slots,), np.int32)
                 for s in active:
@@ -3812,6 +3856,7 @@ class InferenceEngine:
                        if s not in self.slot_prefill
                        and self.slot_req[s] is not None] + [C + n])
                 W = self._paged_width(need)
+                self._pulse_view(W)
                 starts = np.minimum(starts, W - C)
                 valid = np.zeros((self.max_slots,), np.int32)
                 for slot, st, chunk in entries:
@@ -4177,6 +4222,12 @@ class InferenceEngine:
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
 
+    def _hbm_book(self, owner: str, n_bytes: int) -> None:
+        """Book one durable allocation under ``owner`` and remember it
+        so ``stop()`` frees exactly what ``__init__`` booked."""
+        self._hbm.book(owner, n_bytes)
+        self._hbm_booked[owner] = n_bytes
+
     def stop(self):
         self._stop.set()
         if self._thread is not None:
@@ -4185,6 +4236,13 @@ class InferenceEngine:
             # drop every session pin (and stop the publisher) so pool
             # leak checks see only live-slot references after shutdown
             self.session_store.close()
+        # return every ledger byte this engine booked (idempotent — a
+        # second stop() finds the books already empty)
+        for owner, n in self._hbm_booked.items():
+            self._hbm.book(owner, -n)
+        self._hbm_booked = {}
+        if self.paged is not None:
+            self.paged.close()
 
     def is_alive(self) -> bool:
         """True while the engine can still make progress on submitted
@@ -4209,6 +4267,8 @@ class InferenceEngine:
                 "max_slots": self.max_slots,
                 "cache_len": self.cache_len,
                 "kv_tokens_reserved": self.max_slots * self.cache_len,
+                "ledger_account": "kv.contiguous",
+                "kv_bytes": self._hbm_booked.get("kv.contiguous", 0),
             }
         snap = self.paged.debug_snapshot()
         live = 0
@@ -4235,6 +4295,9 @@ class InferenceEngine:
         # budget, the draft cache's contiguous bytes were deducted from
         # the page pool (token-equivalent) so admission can't over-admit
         snap["draft_kv_reserved_tokens"] = self.draft_kv_reserved_tokens
+        # the same reservation in bytes, as the ledger books it (account
+        # kv.draft) — /debug/kv and /debug/hbm agree on the draft tax
+        snap["draft_kv_account_bytes"] = self._hbm_booked.get("kv.draft", 0)
         if self.prefix_cache is not None:
             snap["prefix_index_entries"] = self.prefix_cache.n_entries
         return snap

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llm_in_practise_tpu.obs.hbm import get_ledger
 from llm_in_practise_tpu.obs.logging import get_logger
 from llm_in_practise_tpu.peft.lora import LoRAConfig, stack_lora_tree
 
@@ -395,6 +396,11 @@ class AdapterRegistry:
             self.bytes_loaded += n_bytes
             self.loads_total += 1
             self.swap_seconds_total += time.monotonic() - t0
+            # HBM ledger: payload bytes under the rank bucket's account
+            # (adapters/r<b>); the pow2 bank-capacity padding beyond
+            # the payload shows up in the reconciliation residual, not
+            # here — docs/observability.md "Memory plane"
+            get_ledger().book(f"adapters/r{rb}", n_bytes)
 
     def _place(self, arr, key: str, *, part: str):
         """TP placement: factor banks shard with the BASE weight's rule
@@ -448,6 +454,7 @@ class AdapterRegistry:
                            "(%d bytes)", victim.name, victim.n_bytes)
             self._evict_locked(victim)
             self.evictions_total += 1
+            get_ledger().note_reclaim(f"adapters/r{victim.rb}", "budget")
 
     def _evict_locked(self, rec: _AdapterRec) -> None:
         """Free ``rec``'s bank row (zeroed on reuse, not here — the
@@ -456,6 +463,7 @@ class AdapterRegistry:
         self._adapters.pop(rec.name, None)
         self._buckets[rec.rb].free.append(rec.row)
         self.bytes_loaded -= rec.n_bytes
+        get_ledger().book(f"adapters/r{rec.rb}", -rec.n_bytes)
 
     def evict(self, name: str) -> bool:
         """Explicit unload; refuses while requests are in flight."""

@@ -55,6 +55,8 @@ import threading
 
 import numpy as np
 
+from llm_in_practise_tpu.obs.hbm import get_ledger
+
 #: physical page 0 is never allocated: host-built scatter indices route
 #: every discarded write (idle rows, padding beyond a row's valid
 #: window) into it, and unmapped logical pages gather from it (those
@@ -158,6 +160,33 @@ class PagePool:
             for r in live:
                 counts[int(r)] = counts.get(int(r), 0) + 1
             return counts
+
+    def snapshot(self) -> dict:
+        """Every occupancy/sharing/churn figure under ONE lock hold.
+
+        The per-field properties above each take the lock separately —
+        fine for a single gauge, but a multi-field report stitched from
+        them can tear (a release between ``used_pages`` and
+        ``shared_pages`` makes the sums disagree). ``/debug/kv`` and
+        the ledger cross-check read through here so their page math is
+        internally consistent by construction."""
+        with self._lock:
+            refs = self._refs[1:]
+            live = refs[refs > 0]
+            hist: dict[int, int] = {}
+            for r in live:
+                hist[int(r)] = hist.get(int(r), 0) + 1
+            free = len(self._free)
+            return {
+                "capacity": self.num_pages - 1,
+                "free_pages": free,
+                "used_pages": self.num_pages - 1 - free,
+                "shared_pages": int(np.sum(refs > 1)),
+                "refcount_histogram": hist,
+                "allocs": self.allocs,
+                "frees": self.frees,
+                "alloc_failures": self.alloc_failures,
+            }
 
     # -- alloc / share / release ----------------------------------------------
 
@@ -305,6 +334,31 @@ class PagedKV:
         if mesh is not None:
             kv = jax.device_put(kv, self._pool_shardings(kv, mesh))
         self.kv = kv
+        # ledger account kv_pool.pages: the flat pools are the one real
+        # device allocation here — page/row rates derive from it so
+        # every page-count figure converts to bytes the same way
+        # everywhere (/debug/kv, /debug/hbm, session pins).
+        self.pool_bytes = sum(int(buf.nbytes) for layer in kv
+                              for buf in layer.values())
+        self.row_bytes = self.pool_bytes // pool_rows if pool_rows else 0
+        self.page_bytes = self.row_bytes * self.page_size
+        self._ledger_open = True
+        get_ledger().book("kv_pool.pages", self.pool_bytes)
+
+    def close(self) -> None:
+        """Release the pool's ledger claim (engine stop). Idempotent —
+        a double stop must not double-free the account."""
+        if self._ledger_open:
+            self._ledger_open = False
+            get_ledger().book("kv_pool.pages", -self.pool_bytes)
+
+    def view_bytes(self, width: int, n_slots: int | None = None) -> int:
+        """Device bytes of one transient gather view: ``n_slots`` rows
+        of ``width`` tokens at the pool's per-row rate — what a paged
+        dispatch materializes NEXT TO the pool (the coexistence bytes
+        ROADMAP item 1 reclaims)."""
+        s = self.max_slots if n_slots is None else int(n_slots)
+        return int(width) * s * self.row_bytes
 
     @staticmethod
     def _pool_shardings(kv, mesh):
@@ -434,24 +488,34 @@ class PagedKV:
 
     def debug_snapshot(self) -> dict:
         """The ``GET /debug/kv`` payload: pool occupancy, sharing,
-        fragmentation, and per-slot block-table sizes."""
-        pool = self.pool
-        used = pool.used_pages
+        fragmentation, and per-slot block-table sizes.
+
+        Pool state comes from ONE :meth:`PagePool.snapshot` (a report
+        stitched from the per-field properties could tear between lock
+        acquisitions), and every page figure is cross-linked to ledger
+        account ``kv_pool.pages`` at the pool's own byte rate — so
+        ``/debug/kv`` and ``/debug/hbm`` cannot disagree on what a page
+        costs."""
+        pool = self.pool.snapshot()
         # internal fragmentation: allocated-but-unfilled token slack of
         # the slot-mapped pages (tail of each slot's last page)
         mapped = int(np.sum(self.slot_pages_n))
         return {
             "layout": "paged",
             "page_size": self.page_size,
-            "pages_total": pool.capacity,
-            "pages_free": pool.free_pages,
-            "pages_used": used,
-            "pages_shared": pool.shared_pages,
+            "pages_total": pool["capacity"],
+            "pages_free": pool["free_pages"],
+            "pages_used": pool["used_pages"],
+            "pages_shared": pool["shared_pages"],
             "pages_slot_mapped": mapped,
             "refcount_histogram": {
                 str(k): v for k, v in
-                sorted(pool.refcount_histogram().items())},
-            "alloc_failures": pool.alloc_failures,
+                sorted(pool["refcount_histogram"].items())},
+            "alloc_failures": pool["alloc_failures"],
             "block_table_pages_per_slot": [
                 int(n) for n in self.slot_pages_n],
+            "ledger_account": "kv_pool.pages",
+            "page_bytes": self.page_bytes,
+            "pool_bytes": self.pool_bytes,
+            "slot_mapped_bytes": mapped * self.page_bytes,
         }

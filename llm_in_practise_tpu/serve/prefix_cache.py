@@ -378,6 +378,9 @@ class PagedPrefixIndex:
             while len(dropped) < n and self._entries:
                 dropped.extend(self._evict_lru_locked())
         if dropped:
+            from llm_in_practise_tpu.obs.hbm import get_ledger
+
+            get_ledger().note_reclaim("kv_pool.pages", "prefix_evict")
             self.pool.release(dropped)
         return len(dropped)
 

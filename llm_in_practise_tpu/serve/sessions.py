@@ -56,6 +56,7 @@ import threading
 import time
 from collections import OrderedDict
 
+from llm_in_practise_tpu.obs.hbm import get_ledger
 from llm_in_practise_tpu.obs.logging import get_logger
 
 
@@ -191,6 +192,7 @@ class SessionStore:
         self.engine = None
         self.pool = None
         self.page_size = 0
+        self._page_bytes = 0  # set by attach() from the paged pool's rate
         self.handoff = None
         self._pub_q: "queue.Queue" = queue.Queue()
         self._pub_thread: threading.Thread | None = None
@@ -213,6 +215,7 @@ class SessionStore:
             return
         self.pool = paged.pool
         self.page_size = paged.page_size
+        self._page_bytes = paged.page_bytes
         prior = self.pool.reclaim
 
         def _reclaim(n: int, _prior=prior) -> int:
@@ -223,7 +226,14 @@ class SessionStore:
 
         self.pool.reclaim = _reclaim
 
-    # --- engine-side lifecycle -----------------------------------------------
+    def _book_pins(self, delta_pages: int) -> None:
+        """Move ledger account ``session_pins`` by ``delta_pages`` at
+        the pool's page byte rate. A VIEW account: the bytes belong to
+        ``kv_pool.pages`` — this re-attributes them to the sessions
+        holding the refs, it never adds to the device sum."""
+        if delta_pages and self._page_bytes:
+            get_ledger().book("session_pins",
+                              delta_pages * self._page_bytes)
 
     def known(self, sid: str) -> bool:
         """Whether this replica already holds state for ``sid`` (pinned
@@ -262,6 +272,7 @@ class SessionStore:
             if cache_outcome in self.turns_by_cache:
                 self.turns_by_cache[cache_outcome] += 1
             release.extend(self._enforce_locked(now))
+        self._book_pins(len(pages) - len(release))
         if release and self.pool is not None:
             self.pool.release(release)
 
@@ -285,15 +296,18 @@ class SessionStore:
         releases OUTSIDE this store's lock-held pool calls ordering is
         still store→pool, but batching keeps the hot path short)."""
         release: list = []
+        led = get_ledger()
         dead = [sid for sid, s in self._sessions.items()
                 if s.last_used + self.ttl_s <= now]
         for sid in dead:
             release.extend(self._sessions.pop(sid).pages)
             self.evictions["ttl"] += 1
+            led.note_reclaim("session_pins", "ttl")
         while len(self._sessions) > self.max_sessions:
             _, sess = self._sessions.popitem(last=False)
             release.extend(sess.pages)
             self.evictions["capacity"] += 1
+            led.note_reclaim("session_pins", "capacity")
         return release
 
     def sweep(self) -> int:
@@ -303,6 +317,7 @@ class SessionStore:
             before = len(self._sessions)
             release = self._enforce_locked(now)
             died = before - len(self._sessions)
+        self._book_pins(-len(release))
         if release and self.pool is not None:
             self.pool.release(release)
         return died
@@ -328,6 +343,8 @@ class SessionStore:
                 released.extend(sess.pages[len(sess.pages) - take:])
                 del sess.pages[len(sess.pages) - take:]
                 self.evictions["pressure"] += 1
+                get_ledger().note_reclaim("session_pins", "pressure")
+        self._book_pins(-len(released))
         if released and self.pool is not None:
             self.pool.release(released)
         return len(released)
@@ -339,6 +356,7 @@ class SessionStore:
             self._pending.pop(sid, None)
         if sess is None:
             return False
+        self._book_pins(-len(sess.pages))
         if sess.pages and self.pool is not None:
             self.pool.release(sess.pages)
         return True
@@ -415,10 +433,12 @@ class SessionStore:
             self._pub_thread.start()
 
     def _run_publisher(self) -> None:
+        from llm_in_practise_tpu.obs.hbm import host_entry_bytes
         from llm_in_practise_tpu.serve.kv_pool import entry_to_host
 
         while True:
             item = self._pub_q.get()
+            staged = 0
             try:
                 if item is None:
                     return
@@ -426,6 +446,10 @@ class SessionStore:
                 try:
                     host = entry_to_host(entry)
                     host.token_ids = toks
+                    # ledger account handoff_staging (host plane): the
+                    # entry's RAM until the pool put returns
+                    staged = host_entry_bytes(host)
+                    get_ledger().book("handoff_staging", staged)
                     self.handoff.publish(session_hid(sid), host)
                 except Exception as e:  # noqa: BLE001 — a dead pool
                     # degrades THIS session's future migration, nothing
@@ -439,6 +463,8 @@ class SessionStore:
                     with self._lock:
                         self.pulls["published"] += 1
             finally:
+                if staged:
+                    get_ledger().book("handoff_staging", -staged)
                 self._pub_q.task_done()
 
     def flush(self, timeout_s: float = 10.0) -> bool:
@@ -461,6 +487,7 @@ class SessionStore:
             release = [p for s in self._sessions.values() for p in s.pages]
             self._sessions.clear()
             self._pending.clear()
+        self._book_pins(-len(release))
         if release and self.pool is not None:
             self.pool.release(release)
 

@@ -223,6 +223,40 @@ def test_scoreboard_by_version_rollup():
     assert board["slo"]["requests_ok"] == 15
 
 
+def test_scoreboard_hbm_ownership_rollup():
+    """The HBM-ledger gauges scraped from each replica roll up into
+    the scoreboard's ``hbm`` section: per-replica owner attribution +
+    reconciliation residual, and the fleet-wide per-owner sum — so one
+    scrape answers "who holds the fleet's device bytes"."""
+    def _hbm(pool, weights, unattributed):
+        return (
+            "# TYPE llm_hbm_ledger_bytes gauge\n"
+            f'llm_hbm_ledger_bytes{{owner="kv_pool.pages"}} {pool}\n'
+            f'llm_hbm_ledger_bytes{{owner="weights/model"}} {weights}\n'
+            "# TYPE llm_hbm_unattributed_bytes gauge\n"
+            f"llm_hbm_unattributed_bytes {unattributed}\n")
+    pages = {
+        "r0": _expo(requests=1, extra=_hbm(1000, 5000, 64)),
+        "r1": _expo(requests=1, extra=_hbm(3000, 5000, 0)),
+        "r2": _expo(requests=1),                 # no ledger: omitted
+    }
+    coll = FleetCollector(sorted(pages), fetch=_Fetch(pages), debug=False)
+    coll.poll()
+    board = coll.scoreboard()
+    hbm = board["hbm"]
+    assert set(hbm["replicas"]) == {"r0", "r1"}
+    assert hbm["replicas"]["r0"]["owners"] == {
+        "kv_pool.pages": 1000.0, "weights/model": 5000.0}
+    assert hbm["replicas"]["r0"]["unattributed_bytes"] == 64.0
+    assert hbm["owners"] == {"kv_pool.pages": 4000.0,
+                             "weights/model": 10000.0}
+    from tools.fleet_report import render
+    text = render(board)
+    assert "== hbm ownership ==" in text
+    assert "kv_pool.pages" in text and "4000" in text
+    assert "unattributed=64" in text
+
+
 # --- canary verdicts ---------------------------------------------------------
 
 

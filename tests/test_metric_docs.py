@@ -23,6 +23,49 @@ def test_every_registered_family_is_documented():
         "each (see tools/check_metric_docs.py)")
 
 
+def test_every_booked_ledger_account_is_in_the_glossary():
+    findings = check_metric_docs.check_ledger_owners()
+    assert not findings, (
+        "HBM-ledger accounts booked in code but missing from the "
+        f"docs/observability.md Memory-plane glossary: {findings}")
+
+
+def test_ledger_census_scans_call_sites_and_normalizes_fstrings(tmp_path):
+    pkg = tmp_path / "llm_in_practise_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    (pkg / "thing.py").write_text(
+        'led.book("kv_pool.pages", n)\n'
+        'led.pulse(f"adapters/r{rb}", n)\n'
+        'self._hbm_book("weights/model", n)\n'
+        'led.note_reclaim("session_pins", "ttl")\n'
+        'led.book(owner, n)                # variable: not censused\n')
+    acc = check_metric_docs.ledger_accounts(root=str(tmp_path))
+    assert set(acc) == {"kv_pool.pages", "adapters/r*", "weights/model",
+                        "session_pins"}
+    assert acc["adapters/r*"] == [
+        os.path.join("llm_in_practise_tpu", "thing.py") + ":2"]
+
+
+def test_ledger_glossary_matching():
+    md = ("### Memory plane — the HBM ledger\n"
+          "| account | plane | booked by |\n"
+          "|---|---|---|\n"
+          "| `weights/*` | device | engine |\n"
+          "| `kv_pool.pages` | device | pool |\n"
+          "### Next section\n"
+          "| `llm_not_an_account` | gauge | outside the section |\n")
+    pats = check_metric_docs.glossary_patterns(md)
+    assert pats == {"weights/*", "kv_pool.pages"}
+    findings = check_metric_docs.check_ledger_owners(
+        md_text=md,
+        accounts={"weights/draft_model": ["a.py:1"],     # glob match
+                  "kv_pool.pages": ["b.py:2"],           # exact match
+                  "rogue_account": ["c.py:3"]})          # undocumented
+    assert len(findings) == 1
+    assert "rogue_account" in findings[0] and "c.py:3" in findings[0]
+
+
 def test_doc_pattern_notation():
     pats = check_metric_docs.doc_patterns(
         "| `llm_cache_{exact_hits,misses}_total` | counter |\n"

@@ -23,6 +23,16 @@ dashboard silently flat (``[grafana]`` findings). Histogram
 ``_bucket``/``_sum``/``_count`` sample suffixes resolve to their base
 family first.
 
+Third pass, the **HBM ledger owner census** (``[hbm-ledger]``
+findings): every account name booked anywhere in the stack — a string
+literal passed to ``book``/``pulse``/``note_reclaim``/``transfer``
+(f-string fields normalize to ``*``, so ``f"adapters/r{rb}"`` checks
+as ``adapters/r*``) — must match a pattern in the
+``docs/observability.md`` "Memory plane" account glossary. An account
+booked at a call site but absent from the glossary is exactly the
+drift the ledger exists to prevent: bytes with an owner nobody can
+look up.
+
 Run standalone: ``python tools/check_metric_docs.py``. Report lines and
 exit codes follow the repo's shared checker contract
 (``tools/graftlint/report.py``): rc 0 clean, rc 1 on drift, rc 2 on an
@@ -54,6 +64,16 @@ _NAME_TOKEN = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:{},*]*")
 _EXPR_METRIC = re.compile(
     r"\b((?:llm|gateway|kvpool|moderation)_[a-zA-Z0-9_]+)")
 _HISTO_SUFFIXES = ("_bucket", "_count", "_sum")
+
+# a ledger booking call with a literal owner: any callable ending in
+# book/pulse/note_reclaim/transfer (methods AND wrappers like the
+# engine's _hbm_book) whose first argument is a (possibly f-) string
+_LEDGER_CALL = re.compile(
+    r"(?:book|pulse|note_reclaim|transfer)\(\s*(f?)([\"'])([^\"']+)\2")
+# directories whose booking call sites the owner census walks
+_LEDGER_SRC_DIRS = ("llm_in_practise_tpu", "tools")
+# the docs glossary table row: | `account` | plane | booked by |
+_GLOSSARY_ROW = re.compile(r"^\|\s*`([^`\s]+)`\s*\|")
 
 
 def doc_patterns(md_text: str) -> set[str]:
@@ -234,6 +254,70 @@ def check_grafana(registered=None, md_text: str | None = None,
     return findings
 
 
+def ledger_accounts(root: str = REPO) -> dict[str, list[str]]:
+    """``account pattern -> ["path:line", ...]`` for every literal
+    owner booked anywhere in the stack. f-string replacement fields
+    normalize to ``*`` so dynamic owners (``f"adapters/r{rb}"``) still
+    census as one pattern."""
+    out: dict[str, list[str]] = {}
+    for top in _LEDGER_SRC_DIRS:
+        for dirpath, _dirnames, filenames in os.walk(
+                os.path.join(root, top)):
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        for m in _LEDGER_CALL.finditer(line):
+                            owner = m.group(3)
+                            if m.group(1):      # f-string: {rb} -> *
+                                owner = re.sub(r"\{[^{}]*\}", "*", owner)
+                            site = (f"{os.path.relpath(path, root)}"
+                                    f":{lineno}")
+                            out.setdefault(owner, []).append(site)
+    return out
+
+
+def glossary_patterns(md_text: str | None = None) -> set[str]:
+    """Account patterns from the docs "Memory plane" glossary table
+    (first cell of each row), ``*`` globs included."""
+    if md_text is None:
+        with open(DOC, encoding="utf-8") as f:
+            md_text = f.read()
+    out: set[str] = set()
+    in_section = False
+    for line in md_text.split("\n"):
+        if line.startswith("### "):
+            in_section = line.startswith("### Memory plane")
+            continue
+        if in_section:
+            m = _GLOSSARY_ROW.match(line)
+            if m and m.group(1) not in ("account",):
+                out.add(m.group(1))
+    return out
+
+
+def check_ledger_owners(md_text: str | None = None,
+                        accounts: dict | None = None) -> list[str]:
+    """Booked accounts missing from the docs glossary (sorted; one
+    finding per account, anchored at its first call site)."""
+    patterns = glossary_patterns(md_text)
+    if accounts is None:
+        accounts = ledger_accounts()
+    findings = []
+    for owner in sorted(accounts):
+        if owner in patterns:
+            continue
+        if any("*" in p and fnmatch.fnmatch(owner, p) for p in patterns):
+            continue
+        findings.append(
+            f"{accounts[owner][0]}: [hbm-ledger] account {owner!r} is "
+            "booked here but missing from the docs/observability.md "
+            "Memory-plane glossary")
+    return findings
+
+
 def main() -> int:
     from tools.graftlint import report
 
@@ -243,6 +327,7 @@ def main() -> int:
         registered = collect_registered()
         missing = check(registered=registered)
         grafana = check_grafana(registered=registered)
+        ledger = check_ledger_owners()
     except Exception as e:  # noqa: BLE001 — a broken registry census is
         # an internal error (rc 2), not "zero drift"
         print(f"check_metric_docs: cannot build the registry census: "
@@ -252,12 +337,16 @@ def main() -> int:
         "check_metric_docs",
         [f"{doc_rel}: [metric-docs] {name}: registered metric family "
          "missing from the docs catalog" for name in missing]
-        + [f"{dash_rel}: [grafana] {line}" for line in grafana],
+        + [f"{dash_rel}: [grafana] {line}" for line in grafana]
+        + ledger,
         ok_summary=(f"every registered metric family is documented in "
                     f"{doc_rel}; every {dash_rel} panel expression "
-                    "resolves to a registered, documented family"),
-        fail_hint="Add a catalog row (docs/observability.md) for each, "
-                  "or fix the drifted name.")
+                    "resolves to a registered, documented family; every "
+                    "booked HBM-ledger account is in the Memory-plane "
+                    "glossary"),
+        fail_hint="Add a catalog row / glossary row "
+                  "(docs/observability.md) for each, or fix the "
+                  "drifted name.")
 
 
 if __name__ == "__main__":

@@ -88,6 +88,16 @@ def render(board: dict, *, verdict: dict | None = None) -> str:
                    f"goodput={_fmt(v['goodput_fraction'])} "
                    f"tokens={v['tokens_generated']:.0f} "
                    f"resets={v['resets']}")
+    if board.get("hbm"):
+        out.append("")
+        out.append("== hbm ownership ==")
+        hbm = board["hbm"]
+        for owner, v in sorted(hbm.get("owners", {}).items()):
+            out.append(f"  {owner:<24} {v:.0f}")
+        for url, r in sorted(hbm.get("replicas", {}).items()):
+            unatt = r.get("unattributed_bytes")
+            out.append(f"  {url:<40} unattributed="
+                       f"{_fmt(unatt) if unatt is None else f'{unatt:.0f}'}")
     if verdict is not None:
         out.append("")
         out.append("== canary verdict ==")
